@@ -1,0 +1,16 @@
+"""nerrf_tpu — a TPU-native undo-computing framework.
+
+A ground-up JAX/XLA/Pallas implementation of the capability set specified by the
+NERRF reference (Itz-Agasta/nerrf): streaming syscall-event ingest, a temporal
+dependency graph, GraphSAGE-T + BiLSTM attack detection, an MCTS rollback
+planner with batched value-net rollouts on TPU, and a verified file-level
+rollback executor.
+
+Design stance (see SURVEY.md §7): array-first event pipeline (structure-of-
+arrays from the ingest bridge onward), fixed-capacity padded graph state that
+is XLA-jit friendly, models as pure jitted functions, distributed execution via
+`jax.sharding.Mesh` + XLA collectives over ICI/DCN rather than any NCCL-style
+backend.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,19 @@
+from nerrf_tpu.data.loaders import (
+    GroundTruth,
+    Trace,
+    load_ground_truth_csv,
+    load_trace_jsonl,
+)
+from nerrf_tpu.data.synth import SimConfig, simulate_trace, make_corpus
+from nerrf_tpu.data.labels import derive_event_labels
+
+__all__ = [
+    "GroundTruth",
+    "Trace",
+    "load_ground_truth_csv",
+    "load_trace_jsonl",
+    "SimConfig",
+    "simulate_trace",
+    "make_corpus",
+    "derive_event_labels",
+]

@@ -1,0 +1,297 @@
+"""Synthetic trace corpus generator.
+
+The reference ships only two tiny captured traces (88 and 149 events,
+`benchmarks/m0,m1/results/*_trace.jsonl`) and *specifies* a "100 h benign +
+1 h labelled attack" training corpus that was never built
+(`/root/reference/ROADMAP.md:50`, `README.md:87,103`).  This module is that
+corpus's generator: a benign multi-service workload interleaved with a
+LockBit-style five-phase attack whose structure follows the reference
+simulator (`benchmarks/m1/scripts/sim_lockbit_m1.py`: recon → seed → chunked
+encrypt+rename at a rate limit → ransom note → idle) and threat model
+(`docs/content/docs/architecture.mdx:96-120`).
+
+Everything is generated at syscall granularity (the ~25k-event density the
+docs project for real eBPF capture, `threat-model.mdx:121-137`), with exact
+per-event labels — which the reference's window-level ground truth cannot
+provide — plus the window-level `GroundTruth` for format parity.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional
+
+import numpy as np
+
+from nerrf_tpu.data.loaders import GroundTruth, Trace
+from nerrf_tpu.schema.events import EventArrays, OpenFlags, StringTable, Syscall
+
+_NS = 1_000_000_000
+
+
+@dataclasses.dataclass(frozen=True)
+class SimConfig:
+    """Knobs for one simulated run.  Defaults approximate the reference M1
+    scale (45-50 files of 2-5 MB, ~2 MB/s encrypt rate — sim_lockbit_m1.py:15-22)
+    but at syscall granularity."""
+
+    duration_sec: float = 300.0
+    attack: bool = True
+    attack_start_sec: float = 120.0
+    num_target_files: int = 45
+    min_file_bytes: int = 2 * 1024 * 1024
+    max_file_bytes: int = 5 * 1024 * 1024
+    encrypt_rate_bps: float = 2.0 * 1024 * 1024
+    chunk_bytes: int = 256 * 1024
+    target_dir: str = "/app/uploads"
+    ransom_ext: str = ".lockbit3"
+    # Benign workload intensity: mean syscall events per second across services.
+    benign_rate_hz: float = 60.0
+    seed: int = 0
+
+
+_BENIGN_SERVICES = (
+    # (comm, uid, weight) — a web stack with monitoring and backups, so benign
+    # traffic includes /proc reads, renames, and python3 (non-separable comm).
+    ("nginx", 33, 0.30),
+    ("postgres", 70, 0.20),
+    ("python3", 1000, 0.25),
+    ("node-exporter", 65534, 0.10),
+    ("backup-agent", 0, 0.10),
+    ("logrotate", 0, 0.05),
+)
+
+_DOC_PREFIXES = ("report", "proposal", "analysis", "budget", "customer", "invoice")
+
+
+def _target_file_names(rng: np.random.Generator, n: int) -> List[str]:
+    return [
+        f"{rng.choice(_DOC_PREFIXES)}_{rng.integers(2020, 2027)}_{i:03d}.dat"
+        for i in range(n)
+    ]
+
+
+class _Emitter:
+    def __init__(self):
+        self.records: list[dict] = []
+        self.labels: list[float] = []
+        self._inodes: dict[str, int] = {}
+
+    def inode(self, path: str) -> int:
+        return self._inodes.setdefault(path, 1000 + len(self._inodes))
+
+    def emit(
+        self,
+        ts_ns: int,
+        syscall: Syscall,
+        path: str,
+        *,
+        pid: int,
+        comm: str,
+        attack: bool,
+        new_path: str = "",
+        nbytes: int = 0,
+        flags: int = 0,
+        uid: int = 0,
+        ret_val: int = 0,
+    ) -> None:
+        self.records.append(
+            {
+                "ts_ns": ts_ns,
+                "pid": pid,
+                "tid": pid,
+                "comm": comm,
+                "syscall": syscall,
+                "path": path,
+                "new_path": new_path,
+                "flags": flags,
+                "ret_val": ret_val,
+                "bytes": nbytes,
+                "inode": self.inode(path) if path else 0,
+                "uid": uid,
+            }
+        )
+        self.labels.append(1.0 if attack else 0.0)
+        if new_path:
+            # rename carries the inode forward under the new name
+            self._inodes[new_path] = self._inodes.get(path, self.inode(path))
+
+
+def _emit_benign(em: _Emitter, cfg: SimConfig, rng: np.random.Generator, t0: int) -> None:
+    n = rng.poisson(cfg.benign_rate_hz * cfg.duration_sec)
+    ts = np.sort(rng.uniform(0, cfg.duration_sec, n))
+    weights = np.array([w for _, _, w in _BENIGN_SERVICES])
+    svc = rng.choice(len(_BENIGN_SERVICES), size=n, p=weights / weights.sum())
+    pids = {i: 200 + i for i in range(len(_BENIGN_SERVICES))}
+    log_seq = 0
+    for i in range(n):
+        comm, uid, _ = _BENIGN_SERVICES[svc[i]]
+        pid = pids[int(svc[i])]
+        t = t0 + int(ts[i] * _NS)
+        r = rng.random()
+        if comm == "nginx":
+            if r < 0.5:
+                em.emit(t, Syscall.OPENAT, f"/var/www/static/page_{rng.integers(50)}.html",
+                        pid=pid, comm=comm, uid=uid, attack=False,
+                        flags=int(OpenFlags.O_RDONLY))
+            else:
+                em.emit(t, Syscall.WRITE, "/var/log/nginx/access.log", pid=pid,
+                        comm=comm, uid=uid, attack=False, nbytes=int(rng.integers(80, 400)))
+        elif comm == "postgres":
+            if r < 0.6:
+                em.emit(t, Syscall.WRITE, f"/var/lib/pg/base/{rng.integers(20)}.db",
+                        pid=pid, comm=comm, uid=uid, attack=False,
+                        nbytes=int(rng.integers(512, 8192)))
+            elif r < 0.8:
+                em.emit(t, Syscall.READ, f"/var/lib/pg/base/{rng.integers(20)}.db",
+                        pid=pid, comm=comm, uid=uid, attack=False,
+                        nbytes=int(rng.integers(512, 8192)))
+            else:
+                em.emit(t, Syscall.FSYNC, "/var/lib/pg/wal/000001.log", pid=pid,
+                        comm=comm, uid=uid, attack=False)
+        elif comm == "python3":
+            # An app worker that legitimately touches the target directory.
+            fname = f"{cfg.target_dir}/{rng.choice(_DOC_PREFIXES)}_{rng.integers(2020, 2027)}_{rng.integers(cfg.num_target_files):03d}.dat"
+            if r < 0.45:
+                em.emit(t, Syscall.OPENAT, fname, pid=pid, comm=comm, uid=uid,
+                        attack=False, flags=int(OpenFlags.O_RDONLY))
+            elif r < 0.75:
+                em.emit(t, Syscall.READ, fname, pid=pid, comm=comm, uid=uid,
+                        attack=False, nbytes=int(rng.integers(1024, 65536)))
+            else:
+                em.emit(t, Syscall.WRITE, f"{cfg.target_dir}/.tmp_upload_{rng.integers(9)}",
+                        pid=pid, comm=comm, uid=uid, attack=False,
+                        nbytes=int(rng.integers(1024, 262144)))
+        elif comm == "node-exporter":
+            proc = rng.choice(["/proc/stat", "/proc/meminfo", "/proc/net/dev", "/proc/loadavg"])
+            em.emit(t, Syscall.OPENAT, str(proc), pid=pid, comm=comm, uid=uid,
+                    attack=False, flags=int(OpenFlags.O_RDONLY))
+        elif comm == "backup-agent":
+            if r < 0.7:
+                em.emit(t, Syscall.READ,
+                        f"{cfg.target_dir}/{rng.choice(_DOC_PREFIXES)}_{rng.integers(2020, 2027)}_{rng.integers(cfg.num_target_files):03d}.dat",
+                        pid=pid, comm=comm, uid=uid, attack=False,
+                        nbytes=int(rng.integers(65536, 1 << 20)))
+            else:
+                em.emit(t, Syscall.WRITE, f"/backup/snap_{rng.integers(10)}.bak",
+                        pid=pid, comm=comm, uid=uid, attack=False,
+                        nbytes=int(rng.integers(65536, 1 << 20)))
+        else:  # logrotate: benign rename traffic
+            idx = log_seq % 5
+            log_seq += 1
+            em.emit(t, Syscall.RENAME, f"/var/log/app/service_{idx}.log", pid=pid,
+                    comm=comm, uid=uid, attack=False,
+                    new_path=f"/var/log/app/service_{idx}.log.1")
+
+
+def _emit_attack(em: _Emitter, cfg: SimConfig, rng: np.random.Generator, t0: int) -> tuple[int, int]:
+    """Five-phase LockBit-style attack; returns (start_ns, end_ns)."""
+    pid = 4567
+    comm = "python3"
+    t = t0 + int(cfg.attack_start_sec * _NS)
+    start = t
+
+    def step(lo_ms=2, hi_ms=40):
+        nonlocal t
+        t += int(rng.uniform(lo_ms, hi_ms) * 1e6)
+        return t
+
+    # P1 recon: burst of /proc + system enumeration (threat-model.mdx "Burst of /proc reads")
+    for p in ("/proc/self/status", "/proc/net/tcp", "/etc/passwd", "/proc/diskstats",
+              "/proc/mounts", "/proc/stat"):
+        for _ in range(int(rng.integers(2, 6))):
+            em.emit(step(), Syscall.OPENAT, p, pid=pid, comm=comm, attack=True,
+                    flags=int(OpenFlags.O_RDONLY))
+            em.emit(step(), Syscall.READ, p, pid=pid, comm=comm, attack=True,
+                    nbytes=int(rng.integers(512, 4096)))
+
+    # P2 target discovery
+    em.emit(step(), Syscall.OPENAT, cfg.target_dir, pid=pid, comm=comm, attack=True,
+            flags=int(OpenFlags.O_RDONLY))
+    names = _target_file_names(rng, cfg.num_target_files)
+    for nm in names:
+        em.emit(step(1, 4), Syscall.STAT, f"{cfg.target_dir}/{nm}", pid=pid,
+                comm=comm, attack=True)
+
+    # P3 encrypt loop: per file open→read/write chunks→rename→unlink, rate-limited
+    for nm in names:
+        src = f"{cfg.target_dir}/{nm}"
+        dst = src[: -len(".dat")] + cfg.ransom_ext if src.endswith(".dat") else src + cfg.ransom_ext
+        size = int(rng.integers(cfg.min_file_bytes, cfg.max_file_bytes))
+        em.emit(step(), Syscall.OPENAT, src, pid=pid, comm=comm, attack=True,
+                flags=int(OpenFlags.O_RDWR))
+        nchunks = max(1, size // cfg.chunk_bytes)
+        for _ in range(nchunks):
+            em.emit(step(1, 3), Syscall.READ, src, pid=pid, comm=comm, attack=True,
+                    nbytes=cfg.chunk_bytes)
+            em.emit(step(1, 3), Syscall.WRITE, src, pid=pid, comm=comm, attack=True,
+                    nbytes=cfg.chunk_bytes)
+            # rate limit: advance wall clock to respect encrypt_rate_bps
+            t += int(cfg.chunk_bytes / cfg.encrypt_rate_bps * 1e9)
+        em.emit(step(), Syscall.RENAME, src, pid=pid, comm=comm, attack=True, new_path=dst)
+        em.emit(step(), Syscall.UNLINK, src, pid=pid, comm=comm, attack=True)
+
+    # P4 ransom note
+    note = f"{cfg.target_dir}/README_LOCKBIT.txt"
+    em.emit(step(), Syscall.OPENAT, note, pid=pid, comm=comm, attack=True,
+            flags=int(OpenFlags.O_WRONLY))
+    em.emit(step(), Syscall.WRITE, note, pid=pid, comm=comm, attack=True, nbytes=1337)
+    # P5 idle (no events)
+    return start, t
+
+
+def simulate_trace(cfg: SimConfig, name: str = "") -> Trace:
+    """Generate one labelled trace."""
+    rng = np.random.default_rng(cfg.seed)
+    strings = StringTable()
+    em = _Emitter()
+    t0 = 1_700_000_000 * _NS + int(cfg.seed) * 10_000 * _NS
+    _emit_benign(em, cfg, rng, t0)
+    gt = None
+    if cfg.attack:
+        start, end = _emit_attack(em, cfg, rng, t0)
+        gt = GroundTruth(
+            start_ns=start,
+            end_ns=end,
+            attack_family="LockBitSynthetic",
+            target_path=cfg.target_dir,
+            platform="synthetic",
+            scale=f"{cfg.num_target_files}f",
+        )
+    events = EventArrays.from_records(em.records, strings)
+    labels = np.asarray(em.labels, np.float32)
+    order = np.argsort(events.ts_ns, kind="stable")
+    return Trace(
+        events=events.take(order),
+        strings=strings,
+        ground_truth=gt,
+        labels=labels[order],
+        name=name or f"synth-seed{cfg.seed}",
+    )
+
+
+def make_corpus(
+    n_traces: int,
+    attack_fraction: float = 0.5,
+    base_seed: int = 0,
+    duration_sec: float = 240.0,
+    num_target_files: int = 12,
+    benign_rate_hz: float = 40.0,
+) -> List[Trace]:
+    """A corpus of independent runs (the ROADMAP.md:50 corpus, scaled by args)."""
+    out = []
+    for i in range(n_traces):
+        attack = (i / max(n_traces, 1)) < attack_fraction
+        cfg = SimConfig(
+            duration_sec=duration_sec,
+            attack=attack,
+            attack_start_sec=duration_sec * float(np.random.default_rng(base_seed + i).uniform(0.2, 0.6)),
+            num_target_files=num_target_files,
+            min_file_bytes=64 * 1024,
+            max_file_bytes=256 * 1024,
+            chunk_bytes=32 * 1024,
+            benign_rate_hz=benign_rate_hz,
+            seed=base_seed + i,
+        )
+        out.append(simulate_trace(cfg, name=f"corpus-{i}-{'atk' if attack else 'benign'}"))
+    return out

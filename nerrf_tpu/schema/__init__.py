@@ -1,0 +1,17 @@
+from nerrf_tpu.schema.events import (
+    Syscall,
+    OpenFlags,
+    StringTable,
+    EventArrays,
+    PATH_FEATURE_DIM,
+    path_features,
+)
+
+__all__ = [
+    "Syscall",
+    "OpenFlags",
+    "StringTable",
+    "EventArrays",
+    "PATH_FEATURE_DIM",
+    "path_features",
+]

@@ -1,0 +1,105 @@
+import numpy as np
+import pytest
+
+from nerrf_tpu.schema.events import (
+    EventArrays,
+    StringTable,
+    Syscall,
+    events_to_jsonl,
+    extension_id,
+    format_ns,
+    is_suspicious_extension,
+    parse_iso_timestamp,
+    path_features,
+    PATH_FEATURE_DIM,
+)
+
+
+def test_string_table_interning():
+    st = StringTable()
+    a = st.intern("/app/uploads/x.dat")
+    b = st.intern("/app/uploads/x.dat")
+    c = st.intern("/app/uploads/y.dat")
+    assert a == b != c
+    assert st.intern("") == 0
+    assert st.lookup(a) == "/app/uploads/x.dat"
+    assert st.features().shape == (len(st), PATH_FEATURE_DIM)
+
+
+def test_extension_ids_stable_and_suspicious():
+    assert extension_id("/a/b.dat") == extension_id("/c/d.dat")
+    assert extension_id("/a/b.dat") != extension_id("/a/b.lockbit3")
+    assert extension_id("noext") == 0
+    assert extension_id("/a.b/file") == 0  # dot in dir, not filename
+    assert is_suspicious_extension("/x/y.lockbit3")
+    assert is_suspicious_extension("/x/y.LOCKED")
+    assert not is_suspicious_extension("/x/y.dat")
+
+
+def test_path_features_indicators():
+    f = path_features("/proc/net/tcp")
+    assert f[0] == 1.0 and f.dtype == np.float32
+    assert path_features("/app/uploads/a.lockbit3")[4] == 1.0
+    assert path_features("/app/uploads/README_LOCKBIT.txt")[5] == 1.0
+
+
+def test_event_arrays_roundtrip():
+    st = StringTable()
+    recs = [
+        {
+            "ts_ns": 1_700_000_000_000_000_000 + i,
+            "pid": 100 + i,
+            "comm": "python3",
+            "syscall": "rename" if i % 2 else "write",
+            "path": f"/app/uploads/f_{i}.dat",
+            "new_path": f"/app/uploads/f_{i}.lockbit3" if i % 2 else "",
+            "bytes": 1024 * i,
+            "inode": 5000 + i,
+        }
+        for i in range(7)
+    ]
+    ev = EventArrays.from_records(recs, st)
+    assert len(ev) == ev.num_valid == 7
+    back = list(ev.iter_records(st))
+    assert back[1]["syscall"] == "rename"
+    assert back[1]["new_path"].endswith(".lockbit3")
+    assert back[3]["bytes"] == 3072
+
+
+def test_pad_take_concat_sort():
+    st = StringTable()
+    ev = EventArrays.from_records(
+        [{"ts_ns": t, "pid": 1, "syscall": "write", "path": "/x"} for t in (3, 1, 2)],
+        st,
+    )
+    s = ev.sort_by_time()
+    assert list(s.ts_ns) == [1, 2, 3]
+    p = ev.pad_to(8)
+    assert len(p) == 8 and p.num_valid == 3
+    with pytest.raises(ValueError):
+        p.pad_to(4)
+    c = EventArrays.concatenate([ev, p])
+    assert len(c) == 11 and c.num_valid == 6
+    assert EventArrays.concatenate([]).num_valid == 0
+
+
+def test_timestamp_parsing():
+    ns = parse_iso_timestamp("2025-08-30T14:07:06.542871")
+    assert format_ns(ns).startswith("2025-08-30T14:07:06.542871")
+    assert parse_iso_timestamp("2025-08-30T14:06:45Z") == parse_iso_timestamp(
+        "2025-08-30T14:06:45+00:00"
+    )
+
+
+def test_jsonl_serialization():
+    st = StringTable()
+    ev = EventArrays.from_records(
+        [{"ts_ns": 1_700_000_000_000_000_000, "pid": 9, "syscall": "openat", "path": "/p"}], st
+    )
+    out = events_to_jsonl(ev, st)
+    assert '"syscall": "openat"' in out and '"timestamp"' in out
+
+
+def test_syscall_parse_unknown():
+    assert Syscall.parse("openat") == Syscall.OPENAT
+    assert Syscall.parse("bizarre_call") == Syscall.OTHER
