@@ -21,6 +21,7 @@ import numpy as np
 
 from nerrf_tpu.schema.events import (
     EventArrays,
+    InodeTable,
     OpenFlags,
     StringTable,
     Syscall,
@@ -105,7 +106,7 @@ _SUFFIX_FOR_ENUM = {
 }
 
 
-def _lower_sim_record(rec: dict, inode_of: dict) -> dict:
+def _lower_sim_record(rec: dict, inodes: InodeTable) -> dict:
     """Lower one simulator-format JSON record to a schema record.  Phase
     markers and unknown event names are kept as MARKER events so record counts
     track trace-line counts."""
@@ -146,18 +147,16 @@ def _lower_sim_record(rec: dict, inode_of: dict) -> dict:
     else:
         out["syscall"] = Syscall.MARKER
         out["path"] = path
-    # Stable synthetic inodes: the reference dedups graph nodes by inode
-    # (architecture.mdx:39 "Node merging (inode deduplication)"); traces that
-    # lack inode fields get one per path, and renames carry the inode to the
-    # destination path so one physical file stays one graph node.
-    key = out.get("path", "")
-    if key and "inode" not in rec:
-        out["inode"] = inode_of.setdefault(key, len(inode_of) + 1000)
-    else:
+    # Stable synthetic inodes for traces that lack inode fields (InodeTable:
+    # one path one inode, renames carry it — the reference's inode dedup).
+    # Records carrying a real inode pin it in the table too, so mixed traces
+    # (some lines with inodes, some without) still resolve one file per inode.
+    src, dst = out.get("path", ""), out.get("new_path", "")
+    if "inode" in rec:
         out["inode"] = int(rec.get("inode", 0) or 0)
-    dst = out.get("new_path", "")
-    if dst and out["inode"]:
-        inode_of[dst] = out["inode"]
+        inodes.register(src, out["inode"], dst)
+    else:
+        out["inode"] = inodes.carry_rename(src, dst) if dst else inodes.get(src)
     return out
 
 
@@ -168,7 +167,7 @@ def load_trace_jsonl(
 ) -> Trace:
     """Load a reference-format (or native-format) ND-JSON trace."""
     strings = strings if strings is not None else StringTable()
-    inode_of: dict[str, int] = {}
+    inodes = InodeTable()
     records = []
     with open(path) as f:
         for line in f:
@@ -177,7 +176,7 @@ def load_trace_jsonl(
                 continue
             if line.startswith("TRACE:"):
                 line = line[len("TRACE:") :].strip()
-            records.append(_lower_sim_record(json.loads(line), inode_of))
+            records.append(_lower_sim_record(json.loads(line), inodes))
     events = EventArrays.from_records(records, strings).sort_by_time()
     gt = load_ground_truth_csv(ground_truth) if ground_truth else None
     return Trace(events=events, strings=strings, ground_truth=gt, name=str(path))

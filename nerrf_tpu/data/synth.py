@@ -24,7 +24,7 @@ from typing import List, Optional
 import numpy as np
 
 from nerrf_tpu.data.loaders import GroundTruth, Trace
-from nerrf_tpu.schema.events import EventArrays, OpenFlags, StringTable, Syscall
+from nerrf_tpu.schema.events import EventArrays, InodeTable, OpenFlags, StringTable, Syscall
 
 _NS = 1_000_000_000
 
@@ -75,10 +75,7 @@ class _Emitter:
     def __init__(self):
         self.records: list[dict] = []
         self.labels: list[float] = []
-        self._inodes: dict[str, int] = {}
-
-    def inode(self, path: str) -> int:
-        return self._inodes.setdefault(path, 1000 + len(self._inodes))
+        self._inodes = InodeTable()
 
     def emit(
         self,
@@ -95,6 +92,11 @@ class _Emitter:
         uid: int = 0,
         ret_val: int = 0,
     ) -> None:
+        inode = (
+            self._inodes.carry_rename(path, new_path)
+            if new_path
+            else self._inodes.get(path)
+        )
         self.records.append(
             {
                 "ts_ns": ts_ns,
@@ -107,14 +109,11 @@ class _Emitter:
                 "flags": flags,
                 "ret_val": ret_val,
                 "bytes": nbytes,
-                "inode": self.inode(path) if path else 0,
+                "inode": inode,
                 "uid": uid,
             }
         )
         self.labels.append(1.0 if attack else 0.0)
-        if new_path:
-            # rename carries the inode forward under the new name
-            self._inodes[new_path] = self._inodes.get(path, self.inode(path))
 
 
 def _emit_benign(em: _Emitter, cfg: SimConfig, rng: np.random.Generator, t0: int) -> None:
@@ -228,8 +227,10 @@ def _emit_attack(em: _Emitter, cfg: SimConfig, rng: np.random.Generator, t0: int
                     nbytes=cfg.chunk_bytes)
             # rate limit: advance wall clock to respect encrypt_rate_bps
             t += int(cfg.chunk_bytes / cfg.encrypt_rate_bps * 1e9)
+        # in-place rename to the ransom extension; the inode survives under
+        # dst (no unlink — neither the reference simulator's rename-by-rewrite
+        # endstate nor real LockBit leaves a deleted old name behind)
         em.emit(step(), Syscall.RENAME, src, pid=pid, comm=comm, attack=True, new_path=dst)
-        em.emit(step(), Syscall.UNLINK, src, pid=pid, comm=comm, attack=True)
 
     # P4 ransom note
     note = f"{cfg.target_dir}/README_LOCKBIT.txt"
@@ -281,7 +282,9 @@ def make_corpus(
     """A corpus of independent runs (the ROADMAP.md:50 corpus, scaled by args)."""
     out = []
     for i in range(n_traces):
-        attack = (i / max(n_traces, 1)) < attack_fraction
+        # Bresenham-spread attack traces through the corpus so any contiguous
+        # train/eval split keeps both classes
+        attack = round((i + 1) * attack_fraction) - round(i * attack_fraction) == 1
         cfg = SimConfig(
             duration_sec=duration_sec,
             attack=attack,

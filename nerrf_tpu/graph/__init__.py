@@ -1,0 +1,21 @@
+from nerrf_tpu.graph.builder import (
+    GraphConfig,
+    GraphBatch,
+    WindowStats,
+    build_window_graph,
+    snapshot_windows,
+    trace_snapshots,
+    NODE_FEATURE_DIM,
+    EDGE_FEATURE_DIM,
+)
+
+__all__ = [
+    "GraphConfig",
+    "GraphBatch",
+    "WindowStats",
+    "build_window_graph",
+    "snapshot_windows",
+    "trace_snapshots",
+    "NODE_FEATURE_DIM",
+    "EDGE_FEATURE_DIM",
+]

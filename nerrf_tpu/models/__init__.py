@@ -1,0 +1,12 @@
+from nerrf_tpu.models.graphsage import GraphSAGET, GraphSAGEConfig
+from nerrf_tpu.models.lstm import ImpactLSTM, LSTMConfig
+from nerrf_tpu.models.joint import NerrfNet, JointConfig
+
+__all__ = [
+    "GraphSAGET",
+    "GraphSAGEConfig",
+    "ImpactLSTM",
+    "LSTMConfig",
+    "NerrfNet",
+    "JointConfig",
+]

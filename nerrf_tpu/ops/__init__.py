@@ -1,0 +1,3 @@
+from nerrf_tpu.ops.segment import segment_sum, segment_mean, gather_rows
+
+__all__ = ["segment_sum", "segment_mean", "gather_rows"]

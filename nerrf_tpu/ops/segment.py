@@ -1,0 +1,72 @@
+"""Sparse neighbor-aggregation primitives.
+
+The graph builder emits edges sorted by destination node, so aggregation is a
+segment reduction over a monotone id vector — the memory-friendly layout for
+TPU.  This module is the single switchboard for those primitives: the default
+path is XLA's fused scatter-add (`jax.ops.segment_sum` with
+``indices_are_sorted=True``); `nerrf_tpu.ops.pallas_segment` provides a
+hand-tiled Pallas kernel for the hot TPU path and registers itself here.
+
+(The reference framework has no sparse ops at all — its AI subsystem was never
+built; this realizes the north-star requirement that neighbor-sampling and
+sparse aggregation be written as Pallas kernels.)
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+# Optional override installed by nerrf_tpu.ops.pallas_segment.register().
+_SEGMENT_SUM_IMPL: Optional[Callable] = None
+
+
+def use_pallas(fn: Optional[Callable]) -> None:
+    """Install (or clear) a pallas segment-sum implementation."""
+    global _SEGMENT_SUM_IMPL
+    _SEGMENT_SUM_IMPL = fn
+
+
+def segment_sum(
+    data: jnp.ndarray,
+    segment_ids: jnp.ndarray,
+    num_segments: int,
+    *,
+    sorted_ids: bool = True,
+) -> jnp.ndarray:
+    """Sum rows of ``data`` [E, F] into ``num_segments`` buckets [N, F]."""
+    if _SEGMENT_SUM_IMPL is not None and sorted_ids and data.ndim == 2:
+        return _SEGMENT_SUM_IMPL(data, segment_ids, num_segments)
+    return jax.ops.segment_sum(
+        data, segment_ids, num_segments=num_segments, indices_are_sorted=sorted_ids
+    )
+
+
+def segment_mean(
+    data: jnp.ndarray,
+    segment_ids: jnp.ndarray,
+    num_segments: int,
+    weights: Optional[jnp.ndarray] = None,
+    *,
+    sorted_ids: bool = True,
+) -> jnp.ndarray:
+    """(Weighted) mean aggregation; safe for empty segments."""
+    if weights is not None:
+        w = weights[:, None] if weights.ndim == 1 else weights
+        total = segment_sum(data * w, segment_ids, num_segments, sorted_ids=sorted_ids)
+        denom = segment_sum(w, segment_ids, num_segments, sorted_ids=sorted_ids)
+    else:
+        total = segment_sum(data, segment_ids, num_segments, sorted_ids=sorted_ids)
+        denom = segment_sum(
+            jnp.ones((data.shape[0], 1), data.dtype), segment_ids, num_segments,
+            sorted_ids=sorted_ids,
+        )
+    return total / jnp.maximum(denom, 1e-6)
+
+
+def gather_rows(table: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
+    """Row gather ``table[idx]`` — kept as a named op so the Pallas blocked
+    gather can swap in on TPU without touching call sites."""
+    return jnp.take(table, idx, axis=0)

@@ -147,6 +147,42 @@ def path_features(path: str) -> np.ndarray:
     )
 
 
+class InodeTable:
+    """Stable synthetic inode assignment for traces that lack inode fields.
+
+    One path ⇒ one inode, and a rename carries the inode to the destination
+    path — the invariant behind the reference's "node merging (inode
+    deduplication)" (`architecture.mdx:39`).  Shared by the trace loaders and
+    the synthetic generator so the policy cannot drift between them.
+    """
+
+    _BASE = 1000
+
+    def __init__(self) -> None:
+        self._of: dict[str, int] = {}
+
+    def get(self, path: str) -> int:
+        if not path:
+            return 0
+        return self._of.setdefault(path, self._BASE + len(self._of))
+
+    def carry_rename(self, src: str, dst: str) -> int:
+        """Record src→dst rename; returns the carried inode."""
+        ino = self.get(src)
+        if dst:
+            self._of[dst] = ino
+        return ino
+
+    def register(self, path: str, inode: int, new_path: str = "") -> None:
+        """Pin a real (externally supplied) inode to path(s), so later
+        inode-less records for the same file resolve consistently."""
+        if inode:
+            if path:
+                self._of[path] = inode
+            if new_path:
+                self._of[new_path] = inode
+
+
 class StringTable:
     """Interns strings to dense int32 ids; id 0 is always the empty string.
 
@@ -237,6 +273,8 @@ class EventArrays:
                 raise ValueError(f"column {name} has length {len(col)} != {n}")
             if col.dtype != dtype:
                 object.__setattr__(self, name, col.astype(dtype))
+        if len(self.valid) != n:
+            raise ValueError(f"column valid has length {len(self.valid)} != {n}")
         if self.valid.dtype != np.bool_:
             self.valid = self.valid.astype(np.bool_)
 
@@ -357,17 +395,30 @@ def parse_iso_timestamp(ts: str) -> int:
     s = ts.strip()
     if s.endswith("Z"):
         s = s[:-1] + "+00:00"
+    # split off the fractional-second digits ourselves: fromisoformat only
+    # understands up to 6, real eBPF timestamps carry 9
+    frac_ns = 0
+    dot = s.find(".")
+    if dot != -1:
+        end = dot + 1
+        while end < len(s) and s[end].isdigit():
+            end += 1
+        digits = s[dot + 1 : end]
+        frac_ns = int(digits.ljust(9, "0")[:9])
+        s = s[:dot] + s[end:]
     dt = datetime.fromisoformat(s)
     if dt.tzinfo is None:
         dt = dt.replace(tzinfo=timezone.utc)
-    whole = int(dt.replace(microsecond=0).timestamp())
-    return whole * 1_000_000_000 + dt.microsecond * 1_000
+    return int(dt.timestamp()) * 1_000_000_000 + frac_ns
 
 
 def format_ns(ts_ns: int) -> str:
     sec, frac_ns = divmod(int(ts_ns), 1_000_000_000)
     dt = datetime.fromtimestamp(sec, tz=timezone.utc)
-    return dt.strftime("%Y-%m-%dT%H:%M:%S") + f".{frac_ns // 1000:06d}Z"
+    base = dt.strftime("%Y-%m-%dT%H:%M:%S")
+    if frac_ns % 1000 == 0:  # μs-granular: reference-identical 6-digit form
+        return base + f".{frac_ns // 1000:06d}Z"
+    return base + f".{frac_ns:09d}Z"
 
 
 def events_to_jsonl(events: EventArrays, strings: StringTable) -> str:

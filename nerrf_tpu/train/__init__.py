@@ -1,0 +1,14 @@
+from nerrf_tpu.train.metrics import roc_auc, f1_score, best_f1
+from nerrf_tpu.train.data import WindowDataset, build_dataset
+from nerrf_tpu.train.loop import TrainConfig, TrainResult, train_nerrfnet
+
+__all__ = [
+    "roc_auc",
+    "f1_score",
+    "best_f1",
+    "WindowDataset",
+    "build_dataset",
+    "TrainConfig",
+    "TrainResult",
+    "train_nerrfnet",
+]

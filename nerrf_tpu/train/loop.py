@@ -1,0 +1,214 @@
+"""Training loop for NerrfNet (the reference's planned `ai/train.py`).
+
+Pure-JAX training: one jitted `train_step` (donated state, bfloat16 compute,
+adamw + cosine schedule), vmapped model over the window batch.  The same step
+function is reused by `nerrf_tpu.parallel` under a device mesh — there the
+batch axis is sharded and XLA inserts the gradient all-reduce over ICI,
+replacing the reference north star's DDP/NCCL design.
+
+Objective = masked, class-rebalanced BCE on edge logits (the GNN's
+edge-anomaly task, `architecture.mdx:49-53`) + node BCE (aux) + sequence BCE
+(the LSTM task, `architecture.mdx:55-59`) — the "joint loss" of
+`ROADMAP.md:68`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from functools import partial
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax.training import train_state
+
+from nerrf_tpu.models.joint import JointConfig, NerrfNet
+from nerrf_tpu.train.data import WindowDataset
+from nerrf_tpu.train.metrics import best_f1, roc_auc
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainConfig:
+    model: JointConfig = JointConfig()
+    batch_size: int = 8
+    num_steps: int = 500
+    learning_rate: float = 2e-3
+    warmup_steps: int = 50
+    weight_decay: float = 1e-4
+    edge_loss_weight: float = 1.0
+    node_loss_weight: float = 0.3
+    seq_loss_weight: float = 1.0
+    pos_weight: float = 8.0  # attack classes are rare
+    seed: int = 0
+    eval_every: int = 100
+
+
+@dataclasses.dataclass
+class TrainResult:
+    state: Any
+    metrics: Dict[str, float]
+    steps_per_sec: float
+    history: list
+
+
+_MODEL_INPUTS = (
+    "node_feat", "node_type", "node_aux", "node_mask", "edge_src", "edge_dst",
+    "edge_feat", "edge_mask", "seq_feat", "seq_mask", "seq_node_idx",
+)
+
+
+def model_inputs(batch: Dict[str, jnp.ndarray]) -> tuple:
+    return tuple(batch[k] for k in _MODEL_INPUTS)
+
+
+def _weighted_bce(logit, label, mask, pos_weight):
+    """Masked BCE-with-logits, positives upweighted."""
+    log_p = jax.nn.log_sigmoid(logit)
+    log_np = jax.nn.log_sigmoid(-logit)
+    loss = -(pos_weight * label * log_p + (1.0 - label) * log_np)
+    return (loss * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+
+
+def make_loss_fn(model: NerrfNet, cfg: TrainConfig):
+    def loss_fn(params, batch, dropout_rng):
+        out = jax.vmap(
+            lambda *args: model.apply(
+                {"params": params}, *args, deterministic=False,
+                rngs={"dropout": dropout_rng},
+            )
+        )(*model_inputs(batch))
+        e_mask = batch["edge_mask"].astype(jnp.float32)
+        n_mask = batch["node_mask"].astype(jnp.float32)
+        s_mask = batch["seq_valid"].astype(jnp.float32)
+        edge_loss = _weighted_bce(out["edge_logit"], batch["edge_label"], e_mask, cfg.pos_weight)
+        node_loss = _weighted_bce(out["node_logit"], batch["node_label"], n_mask, cfg.pos_weight)
+        seq_loss = _weighted_bce(out["seq_logit"], batch["seq_label"], s_mask, cfg.pos_weight)
+        total = (
+            cfg.edge_loss_weight * edge_loss
+            + cfg.node_loss_weight * node_loss
+            + cfg.seq_loss_weight * seq_loss
+        )
+        return total, {"edge_loss": edge_loss, "node_loss": node_loss, "seq_loss": seq_loss}
+
+    return loss_fn
+
+
+def make_train_step(model: NerrfNet, cfg: TrainConfig):
+    loss_fn = make_loss_fn(model, cfg)
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def train_step(state: train_state.TrainState, batch, rng):
+        rng, dropout_rng = jax.random.split(rng)
+        (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            state.params, batch, dropout_rng
+        )
+        state = state.apply_gradients(grads=grads)
+        return state, loss, aux, rng
+
+    return train_step
+
+
+def make_eval_fn(model: NerrfNet):
+    @jax.jit
+    def eval_fn(params, batch):
+        return jax.vmap(
+            lambda *args: model.apply({"params": params}, *args, deterministic=True)
+        )(*model_inputs(batch))
+
+    return eval_fn
+
+
+def init_state(
+    model: NerrfNet, cfg: TrainConfig, sample: Dict[str, np.ndarray], rng
+) -> train_state.TrainState:
+    one = {k: jnp.asarray(v[0]) for k, v in sample.items()}
+    params = model.init(rng, *model_inputs(one), deterministic=True)["params"]
+    schedule = optax.warmup_cosine_decay_schedule(
+        0.0, cfg.learning_rate, cfg.warmup_steps, max(cfg.num_steps, cfg.warmup_steps + 1)
+    )
+    tx = optax.chain(
+        optax.clip_by_global_norm(1.0),
+        optax.adamw(schedule, weight_decay=cfg.weight_decay),
+    )
+    return train_state.TrainState.create(apply_fn=model.apply, params=params, tx=tx)
+
+
+def evaluate(eval_fn, params, ds: WindowDataset, batch_size: int = 8) -> Dict[str, float]:
+    """Masked metrics over a dataset."""
+    edge_scores, edge_labels = [], []
+    node_scores, node_labels = [], []
+    seq_scores, seq_labels = [], []
+    n = len(ds)
+    for i in range(0, n, batch_size):
+        idx = np.arange(i, min(i + batch_size, n))
+        batch = {k: jnp.asarray(v[idx]) for k, v in ds.arrays.items()}
+        out = jax.device_get(eval_fn(params, batch))
+        for j in range(len(idx)):
+            em = ds.arrays["edge_mask"][idx[j]]
+            nm = ds.arrays["node_mask"][idx[j]]
+            sm = ds.arrays["seq_valid"][idx[j]]
+            edge_scores.append(out["edge_logit"][j][em])
+            edge_labels.append(ds.arrays["edge_label"][idx[j]][em])
+            node_scores.append(out["node_logit"][j][nm])
+            node_labels.append(ds.arrays["node_label"][idx[j]][nm])
+            seq_scores.append(out["seq_logit"][j][sm])
+            seq_labels.append(ds.arrays["seq_label"][idx[j]][sm])
+    e_s, e_l = np.concatenate(edge_scores), np.concatenate(edge_labels)
+    n_s, n_l = np.concatenate(node_scores), np.concatenate(node_labels)
+    s_s, s_l = np.concatenate(seq_scores), np.concatenate(seq_labels)
+    seq_f1, seq_t = best_f1(s_l, s_s)
+    return {
+        "edge_auc": roc_auc(e_l, e_s),
+        "node_auc": roc_auc(n_l, n_s),
+        "seq_auc": roc_auc(s_l, s_s),
+        "seq_f1": seq_f1,
+        "seq_f1_threshold": seq_t,
+        "num_edges_eval": float(len(e_l)),
+        "num_seqs_eval": float(len(s_l)),
+    }
+
+
+def train_nerrfnet(
+    train_ds: WindowDataset,
+    eval_ds: Optional[WindowDataset] = None,
+    cfg: Optional[TrainConfig] = None,
+    log=None,
+) -> TrainResult:
+    cfg = cfg or TrainConfig()
+    model = NerrfNet(cfg.model)
+    rng = jax.random.PRNGKey(cfg.seed)
+    rng, init_rng = jax.random.split(rng)
+    state = init_state(model, cfg, train_ds.arrays, init_rng)
+    train_step = make_train_step(model, cfg)
+    eval_fn = make_eval_fn(model)
+
+    n = len(train_ds)
+    order_rng = np.random.default_rng(cfg.seed)
+    history = []
+    # warmup/compile step excluded from timing
+    t_start = None
+    for step in range(cfg.num_steps):
+        idx = order_rng.choice(n, size=min(cfg.batch_size, n), replace=False)
+        batch = {k: jnp.asarray(v[idx]) for k, v in train_ds.arrays.items()}
+        state, loss, aux, rng = train_step(state, batch, rng)
+        if step == 0:
+            jax.block_until_ready(loss)
+            t_start = time.perf_counter()
+        if step % cfg.eval_every == 0 or step == cfg.num_steps - 1:
+            history.append({"step": step, "loss": float(loss)})
+            if log:
+                log(f"step {step}: loss={float(loss):.4f} "
+                    + " ".join(f"{k}={float(v):.4f}" for k, v in aux.items()))
+    jax.block_until_ready(state.params)
+    elapsed = time.perf_counter() - (t_start or time.perf_counter())
+    steps_per_sec = (cfg.num_steps - 1) / elapsed if elapsed > 0 else 0.0
+
+    metrics = evaluate(
+        eval_fn, state.params, eval_ds if eval_ds is not None else train_ds,
+        cfg.batch_size,
+    )
+    return TrainResult(state=state, metrics=metrics, steps_per_sec=steps_per_sec,
+                       history=history)

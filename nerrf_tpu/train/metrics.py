@@ -1,0 +1,64 @@
+"""Evaluation metrics for the detector quality gates.
+
+The reference's CI gates are ROC-AUC ≥ 0.90 for the GNN
+(`/root/reference/ROADMAP.md:26,69`) and F1 ≥ 0.95 for the LSTM
+(`architecture.mdx:59`).  Implemented in numpy (host-side eval; scores come
+back from device as flat arrays).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def roc_auc(labels: np.ndarray, scores: np.ndarray) -> float:
+    """Rank-based AUC (Mann-Whitney).  Returns 0.5 for degenerate inputs."""
+    labels = np.asarray(labels).astype(np.float64).ravel()
+    scores = np.asarray(scores).astype(np.float64).ravel()
+    pos = labels > 0.5
+    n_pos = int(pos.sum())
+    n_neg = len(labels) - n_pos
+    if n_pos == 0 or n_neg == 0:
+        return 0.5
+    order = np.argsort(scores, kind="mergesort")
+    ranks = np.empty(len(scores), np.float64)
+    ranks[order] = np.arange(1, len(scores) + 1)
+    # midrank ties
+    sorted_scores = scores[order]
+    i = 0
+    while i < len(sorted_scores):
+        j = i
+        while j + 1 < len(sorted_scores) and sorted_scores[j + 1] == sorted_scores[i]:
+            j += 1
+        if j > i:
+            ranks[order[i : j + 1]] = (i + j) / 2.0 + 1.0
+        i = j + 1
+    rank_sum = ranks[pos].sum()
+    return float((rank_sum - n_pos * (n_pos + 1) / 2.0) / (n_pos * n_neg))
+
+
+def f1_score(labels: np.ndarray, preds: np.ndarray) -> float:
+    labels = np.asarray(labels).ravel() > 0.5
+    preds = np.asarray(preds).ravel() > 0.5
+    tp = int((labels & preds).sum())
+    fp = int((~labels & preds).sum())
+    fn = int((labels & ~preds).sum())
+    if tp == 0:
+        return 0.0
+    prec = tp / (tp + fp)
+    rec = tp / (tp + fn)
+    return float(2 * prec * rec / (prec + rec))
+
+
+def best_f1(labels: np.ndarray, scores: np.ndarray, n_thresholds: int = 101):
+    """Best F1 over a threshold sweep; returns (f1, threshold)."""
+    scores = np.asarray(scores).ravel()
+    if len(scores) == 0:
+        return 0.0, 0.5
+    lo, hi = float(scores.min()), float(scores.max())
+    best, best_t = 0.0, 0.5
+    for t in np.linspace(lo, hi, n_thresholds):
+        f = f1_score(labels, scores > t)
+        if f > best:
+            best, best_t = f, float(t)
+    return best, best_t

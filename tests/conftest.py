@@ -1,12 +1,24 @@
-"""Test configuration: force an 8-device virtual CPU mesh before JAX imports.
+"""Test configuration: force an 8-device virtual CPU mesh.
 
 Multi-chip sharding is validated on virtual devices (the CI host has at most
 one real TPU chip); see SURVEY.md §4 for the test strategy.
+
+Note: this environment's sitecustomize imports jax at interpreter start (to
+register the axon TPU plugin), so setting JAX_PLATFORMS via os.environ here is
+too late — the backend choice must go through jax.config before the backend
+initializes (initialization is lazy; import-time registration is not).
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def pytest_configure(config):
+    config.addinivalue_line("markers", "slow: long-running end-to-end tests")

@@ -1,0 +1,157 @@
+import numpy as np
+
+from nerrf_tpu.data import SimConfig, simulate_trace
+from nerrf_tpu.graph import (
+    EDGE_FEATURE_DIM,
+    GraphBatch,
+    GraphConfig,
+    NODE_FEATURE_DIM,
+    build_window_graph,
+    trace_snapshots,
+)
+from nerrf_tpu.graph.builder import NODE_TYPE_FILE, NODE_TYPE_PROCESS
+from nerrf_tpu.schema.events import EventArrays, StringTable
+
+
+def _small_trace():
+    return simulate_trace(
+        SimConfig(duration_sec=120.0, attack=True, attack_start_sec=40.0,
+                  num_target_files=6, min_file_bytes=64 * 1024,
+                  max_file_bytes=128 * 1024, chunk_bytes=32 * 1024,
+                  benign_rate_hz=25.0, seed=5)
+    )
+
+
+def test_window_graph_shapes_and_masks():
+    tr = _small_trace()
+    cfg = GraphConfig(window_sec=45.0, max_nodes=64, max_edges=128)
+    t0 = int(tr.events.ts_ns.min())
+    g, stats = build_window_graph(
+        tr.events, tr.strings, t0, t0 + 45_000_000_000, cfg, labels=tr.labels
+    )
+    assert g.node_feat.shape == (64, NODE_FEATURE_DIM)
+    assert g.edge_feat.shape == (128, EDGE_FEATURE_DIM)
+    assert g.num_nodes == stats.num_nodes > 0
+    assert g.num_edges == stats.num_edges > 0
+    # masked-out slots are zero
+    assert g.node_feat[~g.node_mask].sum() == 0
+    # valid edges reference valid nodes
+    e = g.edge_mask
+    assert g.node_mask[g.edge_src[e]].all() and g.node_mask[g.edge_dst[e]].all()
+    # edges sorted by destination for segment reduction
+    assert np.all(np.diff(g.edge_dst[e]) >= 0)
+    # padded edge slots point at the last node slot (segment-sum safe)
+    if (~e).any():
+        assert (g.edge_dst[~e] == cfg.max_nodes - 1).all()
+
+
+def test_node_types_and_keys():
+    tr = _small_trace()
+    cfg = GraphConfig(max_nodes=128, max_edges=256)
+    ts = tr.events.ts_ns
+    g, _ = build_window_graph(tr.events, tr.strings, int(ts.min()), int(ts.max()) + 1,
+                              cfg, labels=tr.labels)
+    types = g.node_type[g.node_mask]
+    assert (types == NODE_TYPE_PROCESS).sum() >= 5  # the benign services + attacker
+    assert (types == NODE_TYPE_FILE).sum() > 10
+    # process keys are pids (small), file keys are inodes (>=1000)
+    keys = g.node_key[g.node_mask]
+    assert keys[types == NODE_TYPE_PROCESS].max() < 10000
+    assert keys[types == NODE_TYPE_FILE].min() >= 1000
+    # is_process feature flag agrees with node_type
+    assert np.array_equal(
+        g.node_feat[g.node_mask, 21] > 0.5, types == NODE_TYPE_PROCESS
+    )
+
+
+def test_attack_window_labels_and_features():
+    tr = _small_trace()
+    gt = tr.ground_truth
+    cfg = GraphConfig(max_nodes=128, max_edges=256)
+    g, _ = build_window_graph(tr.events, tr.strings, gt.start_ns, gt.end_ns + 1,
+                              cfg, labels=tr.labels)
+    # attacker edges labelled, and some suspicious-extension involvement seen
+    assert g.edge_label[g.edge_mask].max() == 1.0
+    assert g.edge_feat[g.edge_mask, 11].max() == 1.0
+    # renamed target files: rename counter set on some file node
+    files = g.node_mask & (g.node_type == NODE_TYPE_FILE)
+    assert g.node_feat[files, 10].max() > 0
+    # node labels mark the attacking process
+    procs = g.node_mask & (g.node_type == NODE_TYPE_PROCESS)
+    assert g.node_label[procs].max() == 1.0
+
+
+def test_benign_window_unlabelled():
+    tr = _small_trace()
+    t0 = int(tr.events.ts_ns.min())
+    g, _ = build_window_graph(
+        tr.events, tr.strings, t0, t0 + 30_000_000_000,
+        GraphConfig(max_nodes=128, max_edges=256), labels=tr.labels
+    )
+    assert g.edge_label.max() == 0.0 and g.node_label.max() == 0.0
+
+
+def test_empty_window():
+    tr = _small_trace()
+    g, stats = build_window_graph(
+        tr.events, tr.strings, 0, 1000, GraphConfig(), labels=tr.labels
+    )
+    assert stats.num_events == g.num_nodes == g.num_edges == 0
+
+
+def test_capacity_overflow_accounting():
+    tr = _small_trace()
+    ts = tr.events.ts_ns
+    cfg = GraphConfig(max_nodes=8, max_edges=4)
+    g, stats = build_window_graph(tr.events, tr.strings, int(ts.min()), int(ts.max()) + 1,
+                                  cfg, labels=tr.labels)
+    assert g.num_nodes <= 8 and g.num_edges <= 4
+    assert stats.dropped_nodes > 0
+    assert stats.dropped_events > 0
+    # still structurally sound
+    e = g.edge_mask
+    assert g.node_mask[g.edge_src[e]].all() and g.node_mask[g.edge_dst[e]].all()
+
+
+def test_trace_snapshots_cover_trace_and_stack():
+    tr = _small_trace()
+    cfg = GraphConfig(window_sec=45.0, stride_sec=20.0, max_nodes=64, max_edges=128)
+    snaps = trace_snapshots(tr, cfg, labels=tr.labels)
+    assert len(snaps) >= 5
+    # at least one window sees the attack
+    assert max(g.edge_label.max() for g, _ in snaps) == 1.0
+    stacked = GraphBatch.stack([g for g, _ in snaps])
+    assert stacked["node_feat"].shape == (len(snaps), 64, NODE_FEATURE_DIM)
+    assert stacked["edge_mask"].shape == (len(snaps), 128)
+
+
+def test_determinism():
+    tr = _small_trace()
+    ts = tr.events.ts_ns
+    cfg = GraphConfig(max_nodes=64, max_edges=128)
+    g1, _ = build_window_graph(tr.events, tr.strings, int(ts.min()), int(ts.max()), cfg, labels=tr.labels)
+    g2, _ = build_window_graph(tr.events, tr.strings, int(ts.min()), int(ts.max()), cfg, labels=tr.labels)
+    for k, v in g1.arrays().items():
+        assert np.array_equal(v, g2.arrays()[k]), k
+
+
+def test_rename_is_node_property_not_new_node():
+    """Inode dedup: rename keeps one file node (spec: 'Node merging (inode
+    deduplication)', architecture.mdx:39)."""
+    st = StringTable()
+    recs = [
+        {"ts_ns": 1_000_000_000, "pid": 1, "syscall": "write", "path": "/d/a.dat",
+         "inode": 500, "bytes": 10},
+        {"ts_ns": 2_000_000_000, "pid": 1, "syscall": "rename", "path": "/d/a.dat",
+         "new_path": "/d/a.lockbit3", "inode": 500},
+        {"ts_ns": 3_000_000_000, "pid": 1, "syscall": "write", "path": "/d/a.lockbit3",
+         "inode": 500, "bytes": 10},
+    ]
+    ev = EventArrays.from_records(recs, st)
+    g, _ = build_window_graph(ev, st, 0, 4_000_000_000, GraphConfig(max_nodes=8, max_edges=8))
+    assert g.num_nodes == 2  # one process + one file
+    files = g.node_mask & (g.node_type == NODE_TYPE_FILE)
+    assert files.sum() == 1
+    # the file carries both the rename count and the suspicious-ext flag
+    assert g.node_feat[files, 10] > 0
+    assert g.node_feat[files, 4].max() == 1.0

@@ -1,0 +1,133 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from nerrf_tpu.data import SimConfig, simulate_trace
+from nerrf_tpu.data.sequences import SEQ_FEATURE_DIM, build_file_sequences
+from nerrf_tpu.graph import GraphConfig
+from nerrf_tpu.models import (
+    GraphSAGEConfig,
+    GraphSAGET,
+    ImpactLSTM,
+    JointConfig,
+    LSTMConfig,
+    NerrfNet,
+)
+from nerrf_tpu.models.graphsage import count_params
+from nerrf_tpu.train.data import DatasetConfig, build_dataset
+from nerrf_tpu.train.loop import model_inputs
+
+
+def _trace():
+    return simulate_trace(
+        SimConfig(duration_sec=90.0, attack=True, attack_start_sec=30.0,
+                  num_target_files=5, min_file_bytes=64 * 1024,
+                  max_file_bytes=96 * 1024, chunk_bytes=32 * 1024,
+                  benign_rate_hz=20.0, seed=1)
+    )
+
+
+def _dataset():
+    cfg = DatasetConfig(
+        graph=GraphConfig(window_sec=45.0, stride_sec=20.0, max_nodes=64, max_edges=128),
+        seq_len=24, max_seqs=32,
+    )
+    return build_dataset([_trace()], cfg)
+
+
+def test_graphsage_forward_shapes_and_masking():
+    ds = _dataset()
+    a = ds.arrays
+    model = GraphSAGET(GraphSAGEConfig(hidden=32, num_layers=3))
+    args = (a["node_feat"][0], a["node_type"][0], a["node_aux"][0], a["node_mask"][0],
+            a["edge_src"][0], a["edge_dst"][0], a["edge_feat"][0], a["edge_mask"][0])
+    params = model.init(jax.random.PRNGKey(0), *args)["params"]
+    out = model.apply({"params": params}, *args)
+    assert out["edge_logit"].shape == (128,)
+    assert out["node_logit"].shape == (64,)
+    assert out["node_emb"].shape == (64, 32)
+    # masked slots forced to large-negative logits
+    em = np.asarray(a["edge_mask"][0])
+    assert np.all(np.asarray(out["edge_logit"])[~em] == -30.0)
+    assert np.isfinite(np.asarray(out["edge_logit"])).all()
+
+
+def test_graphsage_param_count_matches_spec():
+    """Spec: ~28 layers, ~2M params (architecture.mdx:52)."""
+    ds = _dataset()
+    a = ds.arrays
+    model = GraphSAGET(GraphSAGEConfig())  # full-size config
+    args = (a["node_feat"][0], a["node_type"][0], a["node_aux"][0], a["node_mask"][0],
+            a["edge_src"][0], a["edge_dst"][0], a["edge_feat"][0], a["edge_mask"][0])
+    params = jax.eval_shape(
+        lambda: model.init(jax.random.PRNGKey(0), *args)
+    )["params"]
+    n = sum(int(np.prod(p.shape)) for p in jax.tree_util.tree_leaves(params))
+    assert 1_800_000 <= n <= 2_600_000, n
+    assert GraphSAGEConfig().num_layers == 28
+
+
+def test_lstm_padding_invariance():
+    """Left-padding must not change the prediction for the same events."""
+    rng = np.random.default_rng(0)
+    T, F = 16, SEQ_FEATURE_DIM
+    ev = rng.normal(size=(1, 6, F)).astype(np.float32)
+    short = np.zeros((1, T, F), np.float32)
+    short[:, T - 6:] = ev
+    mask_short = np.zeros((1, T), np.bool_)
+    mask_short[:, T - 6:] = True
+    longpad = np.zeros((1, T + 8, F), np.float32)
+    longpad[:, T + 8 - 6:] = ev
+    mask_long = np.zeros((1, T + 8), np.bool_)
+    mask_long[:, T + 8 - 6:] = True
+
+    model = ImpactLSTM(LSTMConfig(hidden=16, num_layers=1, dropout=0.0))
+    params = model.init(jax.random.PRNGKey(1), jnp.asarray(short), jnp.asarray(mask_short))["params"]
+    o1 = model.apply({"params": params}, jnp.asarray(short), jnp.asarray(mask_short))
+    o2 = model.apply({"params": params}, jnp.asarray(longpad), jnp.asarray(mask_long))
+    np.testing.assert_allclose(
+        np.asarray(o1["seq_logit"]), np.asarray(o2["seq_logit"]), rtol=2e-2, atol=2e-2
+    )
+
+
+def test_sequences_builder():
+    tr = _trace()
+    seqs = build_file_sequences(tr, labels=tr.labels, seq_len=24)
+    assert seqs.feat.shape[1:] == (24, SEQ_FEATURE_DIM)
+    assert len(seqs) == len(np.unique(seqs.inode))
+    # attacked files labelled
+    assert seqs.label.max() == 1.0 and seqs.label.min() == 0.0
+    # left padding: mask is a suffix
+    for i in range(len(seqs)):
+        m = seqs.mask[i]
+        first = np.argmax(m)
+        assert m[first:].all()
+    # no feature mass on padded steps
+    assert np.abs(seqs.feat[~seqs.mask]).sum() == 0.0
+
+
+def test_nerrfnet_joint_forward():
+    ds = _dataset()
+    a = {k: jnp.asarray(v[0]) for k, v in ds.arrays.items()}
+    model = NerrfNet(JointConfig().small)
+    params = model.init(jax.random.PRNGKey(0), *model_inputs(a))["params"]
+    out = model.apply({"params": params}, *model_inputs(a))
+    assert set(out) >= {"edge_logit", "node_logit", "seq_logit", "seq_emb", "node_emb"}
+    assert out["seq_logit"].shape == (32,)
+    assert np.isfinite(np.asarray(out["seq_logit"])).all()
+
+
+def test_nerrfnet_jit_recompile_free():
+    """Different windows, same shapes → one compilation."""
+    ds = _dataset()
+    model = NerrfNet(JointConfig().small)
+    a0 = {k: jnp.asarray(v[0]) for k, v in ds.arrays.items()}
+    params = model.init(jax.random.PRNGKey(0), *model_inputs(a0))["params"]
+    fwd = jax.jit(lambda p, *args: model.apply({"params": p}, *args))
+    fwd(params, *model_inputs(a0))
+    n0 = fwd._cache_size()
+    for i in range(1, min(4, len(ds))):
+        ai = {k: jnp.asarray(v[i]) for k, v in ds.arrays.items()}
+        fwd(params, *model_inputs(ai))
+    assert fwd._cache_size() == n0 == 1

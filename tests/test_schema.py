@@ -89,6 +89,12 @@ def test_timestamp_parsing():
     assert parse_iso_timestamp("2025-08-30T14:06:45Z") == parse_iso_timestamp(
         "2025-08-30T14:06:45+00:00"
     )
+    # exact ns round-trip (eBPF timestamps are ns-granular)
+    ns9 = 1756562826_542871123
+    assert parse_iso_timestamp(format_ns(ns9)) == ns9
+    assert parse_iso_timestamp("2025-08-30T14:07:06.542871123Z") % 1000 == 123
+    # μs-granular values keep the reference-identical 6-digit form
+    assert format_ns(1756562826_542871000).endswith(".542871Z")
 
 
 def test_jsonl_serialization():
