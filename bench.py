@@ -1,0 +1,132 @@
+#!/usr/bin/env python3
+"""Benchmark of record: full-size NerrfNet train-steps/sec on TPU.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...extras}
+
+- value: steady-state train-steps/sec of the flagship joint model
+  (28-layer ~2.2M-param GraphSAGE-T + 2×256 BiLSTM, batch of 8 window graphs
+  at full shapes: 256 nodes / 512 edges / 128 sequences × 100 events) on the
+  default JAX backend (the real TPU chip under the driver).
+- vs_baseline: ratio vs the same architecture implemented in PyTorch
+  (`nerrf_tpu/bench/torch_baseline.py`) measured on this host — the
+  reference's planned-but-never-built PyTorch training stack (ROADMAP.md:62-69),
+  which in this CUDA-less environment runs on CPU.
+- extras: held-out-trace edge ROC-AUC (quality gate ≥0.90) and context.
+
+Skip the torch leg with NERRF_BENCH_SKIP_TORCH=1 (vs_baseline then null).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+
+def main() -> None:
+    t_wall = time.perf_counter()
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from nerrf_tpu.data import make_corpus
+    from nerrf_tpu.graph import GraphConfig
+    from nerrf_tpu.models import JointConfig, NerrfNet
+    from nerrf_tpu.train import TrainConfig, build_dataset
+    from nerrf_tpu.train.data import DatasetConfig
+    from nerrf_tpu.train.loop import (
+        evaluate,
+        init_state,
+        make_eval_fn,
+        make_train_step,
+    )
+
+    log = lambda *a: print(*a, file=sys.stderr, flush=True)
+    backend = jax.default_backend()
+    log(f"[bench] backend={backend} devices={jax.devices()}")
+
+    # --- data: corpus at full shapes ----------------------------------------
+    corpus = make_corpus(
+        12, attack_fraction=0.5, base_seed=42, duration_sec=180.0,
+        num_target_files=24, benign_rate_hz=40.0,
+    )
+    ds_cfg = DatasetConfig(
+        graph=GraphConfig(window_sec=45.0, stride_sec=15.0, max_nodes=256, max_edges=512),
+        seq_len=100, max_seqs=128,
+    )
+    train_ds = build_dataset(corpus[:9], ds_cfg)
+    eval_ds = build_dataset(corpus[9:], ds_cfg)
+    log(f"[bench] dataset: {len(train_ds)} train / {len(eval_ds)} eval windows")
+
+    # --- JAX training -------------------------------------------------------
+    cfg = TrainConfig(model=JointConfig(), batch_size=8, num_steps=200,
+                      learning_rate=2e-3, warmup_steps=30, seed=0)
+    model = NerrfNet(cfg.model)
+    rng = jax.random.PRNGKey(0)
+
+    t0 = time.perf_counter()
+    state = jax.jit(lambda r: init_state(model, cfg, train_ds.arrays, r))(rng)
+    jax.block_until_ready(state.params)
+    log(f"[bench] init: {time.perf_counter() - t0:.1f}s")
+
+    train_step = make_train_step(model, cfg)
+    n = len(train_ds)
+    order = np.random.default_rng(0)
+
+    def next_batch():
+        idx = order.choice(n, size=cfg.batch_size, replace=False)
+        return {k: jnp.asarray(v[idx]) for k, v in train_ds.arrays.items()}
+
+    t0 = time.perf_counter()
+    state, loss, aux, rng = train_step(state, next_batch(), rng)
+    jax.block_until_ready(loss)
+    log(f"[bench] first step (compile): {time.perf_counter() - t0:.1f}s")
+
+    timed_steps = cfg.num_steps - 1
+    t0 = time.perf_counter()
+    for _ in range(timed_steps):
+        state, loss, aux, rng = train_step(state, next_batch(), rng)
+    jax.block_until_ready(loss)
+    elapsed = time.perf_counter() - t0
+    steps_per_sec = timed_steps / elapsed
+    log(f"[bench] {timed_steps} steps in {elapsed:.1f}s → {steps_per_sec:.2f} steps/s "
+        f"(final loss {float(loss):.4f})")
+
+    # --- quality gate on held-out traces ------------------------------------
+    metrics = evaluate(make_eval_fn(model), state.params, eval_ds, cfg.batch_size)
+    log(f"[bench] eval: edge_auc={metrics['edge_auc']:.4f} "
+        f"seq_auc={metrics['seq_auc']:.4f} seq_f1={metrics['seq_f1']:.4f}")
+
+    # --- torch baseline (same architecture, this host) ----------------------
+    vs_baseline = None
+    torch_sps = None
+    if os.environ.get("NERRF_BENCH_SKIP_TORCH") != "1":
+        try:
+            from nerrf_tpu.bench.torch_baseline import measure_torch_steps_per_sec
+
+            t0 = time.perf_counter()
+            torch_sps = measure_torch_steps_per_sec(
+                train_ds.arrays, batch_size=cfg.batch_size, timed_steps=3)
+            vs_baseline = steps_per_sec / torch_sps
+            log(f"[bench] torch-cpu baseline: {torch_sps:.3f} steps/s "
+                f"({time.perf_counter() - t0:.1f}s) → vs_baseline={vs_baseline:.1f}x")
+        except Exception as e:  # torch leg must never sink the bench
+            log(f"[bench] torch baseline failed: {e!r}")
+
+    print(json.dumps({
+        "metric": "nerrfnet_train_steps_per_sec",
+        "value": round(steps_per_sec, 3),
+        "unit": "steps/s (batch=8 windows, 256n/512e/128seq)",
+        "vs_baseline": round(vs_baseline, 2) if vs_baseline else None,
+        "backend": backend,
+        "edge_roc_auc": round(metrics["edge_auc"], 4),
+        "seq_f1": round(metrics["seq_f1"], 4),
+        "torch_cpu_steps_per_sec": round(torch_sps, 3) if torch_sps else None,
+        "wall_seconds": round(time.perf_counter() - t_wall, 1),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,170 @@
+"""PyTorch reference implementation of NerrfNet for the bench baseline.
+
+The reference planned its AI subsystem in PyTorch (`/root/reference/ROADMAP.md:62-69`,
+`README.md:72-76` — PyTorch-Geometric GraphSAGE + LSTM) but never wrote it; the
+north-star target is "match ROC-AUC at ≥2× train-steps/sec vs the PyTorch
+implementation".  This module is that PyTorch implementation — the same
+architecture, math and loss as `nerrf_tpu.models` — used to measure the
+baseline steps/sec this environment can actually run (torch is CPU-only here;
+no CUDA is present).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict
+
+import numpy as np
+import torch
+import torch.nn as nn
+
+from nerrf_tpu.graph.builder import AUX_VOCAB
+
+
+def _segment_mean(msg: torch.Tensor, seg: torch.Tensor, num: int, w: torch.Tensor):
+    total = torch.zeros(num, msg.shape[-1])
+    total.index_add_(0, seg, msg * w[:, None])
+    denom = torch.zeros(num, 1)
+    denom.index_add_(0, seg, w[:, None])
+    return total / denom.clamp_min(1e-6)
+
+
+class SageBlock(nn.Module):
+    def __init__(self, hidden: int):
+        super().__init__()
+        self.ln = nn.LayerNorm(hidden)
+        self.w_msg = nn.Linear(hidden, hidden)
+        self.w_self = nn.Linear(2 * hidden, hidden)
+        self.dir_bias = nn.Parameter(torch.zeros(2, hidden))
+
+    def forward(self, h, e_emb, src, dst, edge_w, n):
+        hn = self.ln(h)
+        msg = self.w_msg(hn)
+        m_fwd = msg[src] + e_emb + self.dir_bias[0]
+        m_rev = msg[dst] + e_emb + self.dir_bias[1]
+        agg = _segment_mean(m_fwd, dst, n, edge_w) + _segment_mean(m_rev, src, n, edge_w)
+        return h + torch.nn.functional.gelu(
+            self.w_self(torch.cat([hn, agg], dim=-1))
+        )
+
+
+class TorchNerrfNet(nn.Module):
+    """Same architecture as nerrf_tpu.models.joint.NerrfNet."""
+
+    def __init__(self, node_dim, edge_dim, seq_dim, hidden=160, layers=28,
+                 lstm_hidden=256, lstm_layers=2):
+        super().__init__()
+        self.type_emb = nn.Embedding(4, hidden)
+        self.aux_emb = nn.Embedding(AUX_VOCAB, hidden)
+        self.node_enc = nn.Linear(node_dim, hidden)
+        self.edge_enc = nn.Linear(edge_dim, hidden)
+        self.blocks = nn.ModuleList([SageBlock(hidden) for _ in range(layers)])
+        self.final_ln = nn.LayerNorm(hidden)
+        self.node_head = nn.Linear(hidden, 1)
+        self.edge_head_1 = nn.Linear(4 * hidden, hidden)
+        self.edge_head_2 = nn.Linear(hidden, 1)
+        self.lstm_in = nn.Linear(seq_dim, lstm_hidden)
+        self.lstm = nn.LSTM(lstm_hidden, lstm_hidden, num_layers=lstm_layers,
+                            bidirectional=True, batch_first=True)
+        self.lstm_merge = nn.Linear(2 * lstm_hidden, lstm_hidden)
+        self.pool_ln = nn.LayerNorm(lstm_hidden)
+        self.seq_head = nn.Linear(lstm_hidden, 1)
+        self.seq_to_node = nn.Linear(lstm_hidden, node_dim)
+
+    def forward(self, b: Dict[str, torch.Tensor]):
+        # LSTM branch
+        x = torch.nn.functional.gelu(self.lstm_in(b["seq_feat"]))
+        x = x * b["seq_mask"][..., None]
+        y, _ = self.lstm(x)
+        y = torch.nn.functional.gelu(self.lstm_merge(y))
+        m = b["seq_mask"][..., None]
+        pooled = (y * m).sum(1) / m.sum(1).clamp_min(1.0)
+        pooled = self.pool_ln(pooled)
+        seq_logit = self.seq_head(pooled)[:, 0]
+
+        # fusion into node features
+        node_feat = b["node_feat"].clone()
+        ok = b["seq_node_idx"] >= 0
+        idx = b["seq_node_idx"].clamp_min(0)
+        fused = self.seq_to_node(pooled) * ok[:, None]
+        node_feat.index_add_(0, idx, fused)
+
+        n = node_feat.shape[0]
+        h = torch.nn.functional.gelu(
+            self.node_enc(node_feat) + self.type_emb(b["node_type"]) + self.aux_emb(b["node_aux"])
+        )
+        h = h * b["node_mask"][:, None]
+        e_emb = torch.nn.functional.gelu(self.edge_enc(b["edge_feat"]))
+        edge_w = (b["edge_feat"][:, 12] + 0.1) * b["edge_mask"]
+        for blk in self.blocks:
+            h = blk(h, e_emb, b["edge_src"], b["edge_dst"], edge_w, n)
+            h = h * b["node_mask"][:, None]
+        h = self.final_ln(h)
+        node_logit = self.node_head(h)[:, 0]
+        hs, hd = h[b["edge_src"]], h[b["edge_dst"]]
+        z = torch.nn.functional.gelu(
+            self.edge_head_1(torch.cat([hs, hd, hs * hd, e_emb], dim=-1))
+        )
+        edge_logit = self.edge_head_2(z)[:, 0]
+        return edge_logit, node_logit, seq_logit
+
+
+def _to_torch(sample: Dict[str, np.ndarray]) -> Dict[str, torch.Tensor]:
+    out = {}
+    for k, v in sample.items():
+        t = torch.from_numpy(np.ascontiguousarray(v))
+        if t.dtype in (torch.float64,):
+            t = t.float()
+        if k in ("node_mask", "edge_mask", "seq_mask", "seq_valid"):
+            t = t.float()
+        if k in ("node_type", "node_aux", "edge_src", "edge_dst", "seq_node_idx"):
+            t = t.long()
+        out[k] = t
+    return out
+
+
+def _bce(logit, label, mask, pos_weight):
+    loss = torch.nn.functional.binary_cross_entropy_with_logits(
+        logit, label, reduction="none",
+        pos_weight=torch.tensor(pos_weight),
+    )
+    return (loss * mask).sum() / mask.sum().clamp_min(1.0)
+
+
+def measure_torch_steps_per_sec(
+    arrays: Dict[str, np.ndarray], batch_size: int = 8, timed_steps: int = 5,
+    pos_weight: float = 8.0, threads: int | None = None,
+) -> float:
+    """Train-steps/sec of the torch implementation on this host (CPU)."""
+    if threads:
+        torch.set_num_threads(threads)
+    model = TorchNerrfNet(
+        node_dim=arrays["node_feat"].shape[-1],
+        edge_dim=arrays["edge_feat"].shape[-1],
+        seq_dim=arrays["seq_feat"].shape[-1],
+    )
+    opt = torch.optim.AdamW(model.parameters(), lr=2e-3, weight_decay=1e-4)
+    n = len(arrays["node_feat"])
+    rng = np.random.default_rng(0)
+
+    def one_step():
+        idx = rng.choice(n, size=min(batch_size, n), replace=False)
+        opt.zero_grad()
+        total = 0.0
+        for j in idx:  # per-window loop (torch lacks vmap-jit fusion here)
+            b = _to_torch({k: v[j] for k, v in arrays.items()})
+            e, nd, sq = model(b)
+            loss = (
+                _bce(e, b["edge_label"], b["edge_mask"], pos_weight)
+                + 0.3 * _bce(nd, b["node_label"], b["node_mask"], pos_weight)
+                + _bce(sq, b["seq_label"], b["seq_valid"], pos_weight)
+            )
+            total = total + loss
+        (total / len(idx)).backward()
+        opt.step()
+
+    one_step()  # warmup
+    t0 = time.perf_counter()
+    for _ in range(timed_steps):
+        one_step()
+    return timed_steps / (time.perf_counter() - t0)

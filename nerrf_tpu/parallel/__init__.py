@@ -1,0 +1,12 @@
+from nerrf_tpu.parallel.mesh import MeshConfig, make_mesh, batch_sharding, param_sharding
+from nerrf_tpu.parallel.train import make_sharded_train_step, shard_batch, init_sharded_state
+
+__all__ = [
+    "MeshConfig",
+    "make_mesh",
+    "batch_sharding",
+    "param_sharding",
+    "make_sharded_train_step",
+    "shard_batch",
+    "init_sharded_state",
+]

@@ -1,0 +1,103 @@
+"""Device mesh + sharding layout for multi-chip training and inference.
+
+This is the TPU-native replacement for the reference north star's DDP/NCCL
+design point (see SURVEY.md §2.3): there is no communication *backend* to
+write — we declare a `jax.sharding.Mesh` with named axes and per-array
+`PartitionSpec`s, and XLA lowers the induced collectives (gradient psum,
+activation all-gathers) onto ICI within a slice and DCN across slices.
+
+Axes:
+  * ``dp``  — data parallel: the window-batch dimension of every training
+    array.  Gradient all-reduce rides ICI.
+  * ``tp``  — tensor parallel: hidden dimensions of the larger weight
+    matrices (GNN block kernels, LSTM projections, embeddings).
+  * ``sp``  — sequence parallel, reserved for the long-context stream
+    encoder (ring attention via shard_map+ppermute); no consumer is wired to
+    it yet, so leave sp=1 unless you are that consumer.
+
+Multi-host: `make_mesh` uses all visible devices (`jax.devices()`), which on a
+multi-host TPU pod spans hosts; each host feeds its local shard of the batch
+(`jax.make_array_from_process_local_data`) — the same code path validated here
+on a virtual CPU mesh (tests/conftest.py forces 8 devices).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    dp: int = -1  # -1: use all remaining devices
+    tp: int = 1
+    sp: int = 1
+
+    def resolve(self, n_devices: int) -> tuple[int, int, int]:
+        dp, tp, sp = self.dp, self.tp, self.sp
+        if dp == -1:
+            if n_devices % (tp * sp):
+                raise ValueError(f"{n_devices} devices not divisible by tp*sp={tp * sp}")
+            dp = n_devices // (tp * sp)
+        if dp * tp * sp != n_devices:
+            raise ValueError(f"dp*tp*sp={dp * tp * sp} != {n_devices} devices")
+        return dp, tp, sp
+
+
+def make_mesh(
+    cfg: Optional[MeshConfig] = None, devices: Optional[Sequence[jax.Device]] = None
+) -> Mesh:
+    devices = list(devices if devices is not None else jax.devices())
+    cfg = cfg or MeshConfig()
+    dp, tp, sp = cfg.resolve(len(devices))
+    arr = mesh_utils.create_device_mesh((dp, tp, sp), devices=np.asarray(devices))
+    return Mesh(arr, axis_names=("dp", "tp", "sp"))
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Training batch arrays: leading (window) axis over dp, rest replicated."""
+    return NamedSharding(mesh, P("dp"))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+# --- tensor-parallel parameter layout ---------------------------------------
+#
+# Rule-based partitioner over the flax param tree.  Large 2-D kernels shard
+# their output feature dim over tp; embeddings shard the embedding dim; biases
+# and LayerNorm scales stay replicated.  XLA/GSPMD inserts the matching
+# activation collectives.  Threshold keeps small heads replicated (cheaper
+# than gathering).
+
+_TP_MIN_DIM = 64
+
+
+def _spec_for(path: tuple[str, ...], leaf: jax.ShapeDtypeStruct):
+    shape = leaf.shape
+    name = path[-1] if path else ""
+    if name == "embedding" and len(shape) == 2 and shape[1] >= _TP_MIN_DIM:
+        return P(None, "tp")
+    if name == "kernel" and len(shape) == 2 and shape[1] >= _TP_MIN_DIM:
+        return P(None, "tp")
+    return P()
+
+
+def param_sharding(mesh: Mesh, params) -> dict:
+    """PyTree of NamedShardings matching ``params``' structure."""
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+
+    def path_names(kp):
+        return tuple(getattr(k, "key", getattr(k, "idx", str(k))) for k in kp)
+
+    treedef = jax.tree_util.tree_structure(params)
+    leaves = []
+    for kp, leaf in flat:
+        leaves.append(NamedSharding(mesh, _spec_for(path_names(kp), leaf)))
+    return jax.tree_util.tree_unflatten(treedef, leaves)

@@ -1,0 +1,80 @@
+"""Sharded training step: the multi-chip version of `train.loop`.
+
+Same model, same loss — the only difference is sharding annotations.  The
+window batch is sharded over ``dp``; parameters are laid out by
+`parallel.mesh.param_sharding` (large kernels tensor-parallel over ``tp``,
+the rest replicated).  Under `jax.jit` with these shardings, GSPMD emits the
+gradient all-reduce over dp and the activation collectives for tp — there is
+no hand-written communication anywhere, per the TPU-first design stance
+(SURVEY.md §7).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax.training import train_state
+from jax.sharding import Mesh
+
+from nerrf_tpu.models.joint import NerrfNet
+from nerrf_tpu.parallel.mesh import batch_sharding, param_sharding, replicated
+from nerrf_tpu.train.loop import TrainConfig, make_loss_fn, make_tx, model_inputs
+
+
+def shard_batch(mesh: Mesh, batch: Dict[str, np.ndarray]) -> Dict[str, jax.Array]:
+    """Place a host batch onto the mesh, window axis split over dp."""
+    sh = batch_sharding(mesh)
+    return {k: jax.device_put(jnp.asarray(v), sh) for k, v in batch.items()}
+
+
+def init_sharded_state(
+    model: NerrfNet,
+    cfg: TrainConfig,
+    sample: Dict[str, np.ndarray],
+    mesh: Mesh,
+    rng: Optional[jax.Array] = None,
+) -> train_state.TrainState:
+    """Initialize params directly into their sharded layout (jitted init with
+    output shardings, so no host-side full copy materializes first)."""
+    rng = rng if rng is not None else jax.random.PRNGKey(cfg.seed)
+    one = {k: jnp.asarray(v[0]) for k, v in sample.items()}
+
+    def init_fn(rng):
+        return model.init(rng, *model_inputs(one), deterministic=True)["params"]
+
+    shapes = jax.eval_shape(init_fn, rng)
+    p_shard = param_sharding(mesh, shapes)
+    params = jax.jit(init_fn, out_shardings=p_shard)(rng)
+
+    with mesh:
+        state = train_state.TrainState.create(
+            apply_fn=model.apply, params=params, tx=make_tx(cfg)
+        )
+    return state
+
+
+def make_sharded_train_step(model: NerrfNet, cfg: TrainConfig, mesh: Mesh):
+    """Jitted train step with explicit in/out shardings over the mesh."""
+    loss_fn = make_loss_fn(model, cfg)
+    b_shard = batch_sharding(mesh)
+    r_shard = replicated(mesh)
+
+    @partial(
+        jax.jit,
+        donate_argnums=(0,),
+        in_shardings=(None, b_shard, r_shard),
+        out_shardings=None,
+    )
+    def train_step(state, batch, rng):
+        rng, dropout_rng = jax.random.split(rng)
+        (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            state.params, batch, dropout_rng
+        )
+        state = state.apply_gradients(grads=grads)
+        return state, loss, aux, rng
+
+    return train_step

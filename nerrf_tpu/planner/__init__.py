@@ -1,0 +1,11 @@
+from nerrf_tpu.planner.domain import UndoAction, UndoDomain, UndoPlan, ActionKind
+from nerrf_tpu.planner.mcts import MCTSConfig, MCTSPlanner
+
+__all__ = [
+    "UndoAction",
+    "UndoDomain",
+    "UndoPlan",
+    "ActionKind",
+    "MCTSConfig",
+    "MCTSPlanner",
+]

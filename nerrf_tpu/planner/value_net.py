@@ -1,0 +1,139 @@
+"""Value function for MCTS leaf evaluation — batched on TPU.
+
+Two interchangeable evaluators:
+
+* `HeuristicValue` — closed-form expected remaining reward (sum of positive
+  expected gains minus live-threat forfeit), jitted and vmapped; the
+  zero-training baseline.
+* `ValueNet` — a small flax MLP over `UndoDomain.value_features`, fit by
+  regression on Monte-Carlo returns of prior-guided rollouts (`fit_to_domain`),
+  then served jitted.  This is the "value-net batch dispatch" of the north
+  star: MCTS hands the device a [B, 8] feature block, gets [B] values back.
+
+Both operate on the fixed-width feature summary, so one network serves any
+incident size without recompilation.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from nerrf_tpu.planner.domain import ONGOING_LOSS_MB_PER_SEC, UndoDomain
+
+ValueFn = Callable[[np.ndarray], np.ndarray]  # [B, 8] features → [B] values
+
+
+def heuristic_value(features: jnp.ndarray) -> jnp.ndarray:
+    """Expected remaining reward from the feature summary.
+
+    rem_gain is recoverable data still on the table; live threats forfeit
+    ~30 s of ongoing loss unless killed; downtime already spent is sunk.
+    """
+    rem_gain = features[..., 0]
+    live = features[..., 2]
+    stopped = features[..., 7]
+    future = rem_gain - live * ONGOING_LOSS_MB_PER_SEC * 5.0
+    return jnp.where(stopped > 0.5, 0.0, future)
+
+
+class HeuristicValue:
+    def __init__(self) -> None:
+        self._fn = jax.jit(heuristic_value)
+
+    def __call__(self, features: np.ndarray) -> np.ndarray:
+        return np.asarray(self._fn(jnp.asarray(features)))
+
+
+class _MLP(nn.Module):
+    hidden: int = 64
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.gelu(nn.Dense(self.hidden)(x))
+        x = nn.gelu(nn.Dense(self.hidden)(x))
+        return nn.Dense(1)(x)[..., 0]
+
+
+@dataclasses.dataclass
+class ValueNet:
+    params: dict
+    _apply: Callable
+
+    @classmethod
+    def create(cls, rng: jax.Array | None = None, hidden: int = 64) -> "ValueNet":
+        rng = rng if rng is not None else jax.random.PRNGKey(0)
+        model = _MLP(hidden)
+        params = model.init(rng, jnp.zeros((1, 8)))
+        apply = jax.jit(lambda p, x: model.apply(p, x))
+        return cls(params=params, _apply=apply)
+
+    def __call__(self, features: np.ndarray) -> np.ndarray:
+        return np.asarray(self._apply(self.params, jnp.asarray(features)))
+
+    def fit_to_domain(
+        self,
+        domain: UndoDomain,
+        num_rollouts: int = 512,
+        horizon: int = 32,
+        steps: int = 300,
+        lr: float = 1e-2,
+        seed: int = 0,
+    ) -> float:
+        """Regress value(features) onto MC returns of prior-guided rollouts.
+
+        Rollouts run vectorized on the host domain model (numpy transition),
+        training runs jitted on device.  Returns final MSE loss.
+        """
+        rng = np.random.default_rng(seed)
+        priors = domain.priors()
+        B = num_rollouts
+        s = np.stack([domain.initial_state()] * B)
+        feats, rewards, alive_hist = [], [], []
+        for _ in range(horizon):
+            feats.append(domain.value_features(s))
+            legal = domain.legal_actions(s)
+            p = priors[None, :] * legal
+            rowsum = p.sum(-1, keepdims=True)
+            p = np.where(rowsum > 0, p / np.maximum(rowsum, 1e-9), 0.0)
+            alive = rowsum[:, 0] > 0
+            a = np.array([
+                rng.choice(domain.A, p=p[b]) if alive[b] else domain.A - 1
+                for b in range(B)
+            ])
+            s, r = domain.step_batch(s, a)
+            rewards.append(np.where(alive, r, 0.0))
+            alive_hist.append(alive)
+        returns = np.zeros(B, np.float32)
+        targets = np.zeros((horizon, B), np.float32)
+        for t in reversed(range(horizon)):
+            returns = rewards[t] + returns
+            targets[t] = returns
+        X = jnp.asarray(np.concatenate(feats))
+        Y = jnp.asarray(targets.reshape(-1))
+
+        opt = optax.adam(lr)
+        opt_state = opt.init(self.params)
+
+        @jax.jit
+        def train_step(params, opt_state):
+            def loss_fn(p):
+                pred = self._apply(p, X)
+                return jnp.mean((pred - Y) ** 2)
+
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            updates, opt_state2 = opt.update(grads, opt_state)
+            return optax.apply_updates(params, updates), opt_state2, loss
+
+        params = self.params
+        loss = jnp.inf
+        for _ in range(steps):
+            params, opt_state, loss = train_step(params, opt_state)
+        self.params = params
+        return float(loss)

@@ -121,19 +121,25 @@ def make_eval_fn(model: NerrfNet):
     return eval_fn
 
 
+def make_tx(cfg: TrainConfig) -> optax.GradientTransformation:
+    """The one optimizer recipe, shared by single-device and sharded paths."""
+    schedule = optax.warmup_cosine_decay_schedule(
+        0.0, cfg.learning_rate, cfg.warmup_steps, max(cfg.num_steps, cfg.warmup_steps + 1)
+    )
+    return optax.chain(
+        optax.clip_by_global_norm(1.0),
+        optax.adamw(schedule, weight_decay=cfg.weight_decay),
+    )
+
+
 def init_state(
     model: NerrfNet, cfg: TrainConfig, sample: Dict[str, np.ndarray], rng
 ) -> train_state.TrainState:
     one = {k: jnp.asarray(v[0]) for k, v in sample.items()}
     params = model.init(rng, *model_inputs(one), deterministic=True)["params"]
-    schedule = optax.warmup_cosine_decay_schedule(
-        0.0, cfg.learning_rate, cfg.warmup_steps, max(cfg.num_steps, cfg.warmup_steps + 1)
+    return train_state.TrainState.create(
+        apply_fn=model.apply, params=params, tx=make_tx(cfg)
     )
-    tx = optax.chain(
-        optax.clip_by_global_norm(1.0),
-        optax.adamw(schedule, weight_decay=cfg.weight_decay),
-    )
-    return train_state.TrainState.create(apply_fn=model.apply, params=params, tx=tx)
 
 
 def evaluate(eval_fn, params, ds: WindowDataset, batch_size: int = 8) -> Dict[str, float]:

@@ -1,0 +1,106 @@
+"""Multi-chip sharding tests on the virtual 8-device CPU mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from nerrf_tpu.data import make_corpus
+from nerrf_tpu.graph import GraphConfig
+from nerrf_tpu.models import GraphSAGEConfig, JointConfig, LSTMConfig, NerrfNet
+from nerrf_tpu.parallel import (
+    MeshConfig,
+    init_sharded_state,
+    make_mesh,
+    make_sharded_train_step,
+    shard_batch,
+)
+from nerrf_tpu.parallel.mesh import param_sharding
+from nerrf_tpu.train import TrainConfig, build_dataset
+from nerrf_tpu.train.data import DatasetConfig
+
+
+def _dataset():
+    corpus = make_corpus(4, attack_fraction=0.5, base_seed=3, duration_sec=60.0,
+                         num_target_files=4, benign_rate_hz=15.0)
+    return build_dataset(corpus, DatasetConfig(
+        graph=GraphConfig(window_sec=45.0, stride_sec=30.0, max_nodes=32, max_edges=64),
+        seq_len=16, max_seqs=16,
+    ))
+
+
+def test_mesh_construction():
+    assert len(jax.devices()) == 8, "conftest must force 8 virtual devices"
+    mesh = make_mesh(MeshConfig(dp=-1, tp=2))
+    assert mesh.shape == {"dp": 4, "tp": 2, "sp": 1}
+    mesh = make_mesh(MeshConfig(dp=2, tp=2, sp=2))
+    assert mesh.shape == {"dp": 2, "tp": 2, "sp": 2}
+    with pytest.raises(ValueError):
+        make_mesh(MeshConfig(dp=3, tp=3))
+
+
+def test_param_sharding_rules():
+    mesh = make_mesh(MeshConfig(dp=4, tp=2))
+    model = NerrfNet(JointConfig(
+        gnn=GraphSAGEConfig(hidden=128, num_layers=2),
+        lstm=LSTMConfig(hidden=128, num_layers=1),
+    ))
+    ds = _dataset()
+    one = {k: jnp.asarray(v[0]) for k, v in ds.arrays.items()}
+    from nerrf_tpu.train.loop import model_inputs
+    shapes = jax.eval_shape(
+        lambda: model.init(jax.random.PRNGKey(0), *model_inputs(one))
+    )["params"]
+    shardings = param_sharding(mesh, shapes)
+    flat = jax.tree_util.tree_flatten_with_path(shardings)[0]
+    tp_sharded = [kp for kp, s in flat if s.spec == P(None, "tp")]
+    replicated = [kp for kp, s in flat if s.spec == P()]
+    assert len(tp_sharded) > 10  # big kernels + embeddings
+    assert len(replicated) > 5   # biases, layernorms, small heads
+
+
+@pytest.mark.slow
+def test_sharded_train_step_runs_and_matches_semantics():
+    """One dp×tp-sharded step on the virtual mesh: runs, loss finite, and the
+    sharded loss matches the single-device loss for identical params/batch."""
+    ds = _dataset()
+    n = (len(ds) // 8) * 8 or 8
+    idx = np.arange(n) % len(ds)
+    batch_np = {k: v[idx] for k, v in ds.arrays.items()}
+
+    cfg = TrainConfig(
+        model=JointConfig(
+            gnn=GraphSAGEConfig(hidden=32, num_layers=2, dropout=0.0),
+            lstm=LSTMConfig(hidden=32, num_layers=1, dropout=0.0),
+        ),
+        batch_size=n, num_steps=2, learning_rate=1e-3, warmup_steps=1,
+    )
+    model = NerrfNet(cfg.model)
+    mesh = make_mesh(MeshConfig(dp=4, tp=2))
+    state = init_sharded_state(model, cfg, ds.arrays, mesh)
+    step = make_sharded_train_step(model, cfg, mesh)
+    batch = shard_batch(mesh, batch_np)
+
+    # reference loss on one device with the same (gathered) params
+    from nerrf_tpu.train.loop import make_loss_fn
+    params_host = jax.device_get(state.params)
+    loss_ref, _ = make_loss_fn(model, cfg)(
+        jax.tree.map(jnp.asarray, params_host),
+        {k: jnp.asarray(v) for k, v in batch_np.items()},
+        jax.random.PRNGKey(1),  # dropout 0 → rng irrelevant
+    )
+
+    state2, loss, aux, rng2 = step(state, batch, jax.random.PRNGKey(0))
+    assert np.isfinite(float(loss))
+    np.testing.assert_allclose(float(loss), float(loss_ref), rtol=2e-2)
+    # step 0 runs at lr=0 (warmup); take a second step so params actually move
+    state2, loss2, _, _ = step(state2, batch, rng2)
+    assert np.isfinite(float(loss2))
+    # params actually updated
+    delta = jax.tree_util.tree_reduce(
+        lambda a, p: a + float(jnp.abs(p).sum()),
+        jax.tree.map(lambda a, b: a - b, state2.params, jax.tree.map(jnp.asarray, params_host)),
+        0.0,
+    )
+    assert delta > 0
