@@ -1,0 +1,224 @@
+"""The nerrf command-line interface.
+
+Implements the reference's specified CLI surface (`/root/reference/ROADMAP.md:86`:
+``nerrf undo --id <attack>``, ``nerrf status``; `README.md:81-82`) plus the
+workflow commands the local benchmark needs.  Usage:
+
+    python -m nerrf_tpu.cli simulate       --incident DIR [--files N]
+    python -m nerrf_tpu.cli train-detector --model-dir DIR [--steps N]
+    python -m nerrf_tpu.cli undo           --incident DIR [--model-dir DIR]
+                                           [--dry-run] [--no-gate]
+    python -m nerrf_tpu.cli status         --incident DIR
+
+An *incident directory* is the unit of state: victim files under ``victim/``,
+the snapshot store under ``store/``, the captured trace, and every stage's
+JSON artifact (plan.json, gate.json, report.json) — so ``status`` can always
+reconstruct where an incident stands.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+
+def _log(msg: str) -> None:
+    print(f"[nerrf] {msg}", file=sys.stderr, flush=True)
+
+
+# --------------------------------------------------------------------------
+def cmd_simulate(args) -> int:
+    from nerrf_tpu.rollback import FileSimConfig, SnapshotStore, run_file_attack
+    from nerrf_tpu.rollback.filesim import seed_files
+    from nerrf_tpu.schema.events import events_to_jsonl
+
+    inc = Path(args.incident)
+    victim = inc / "victim"
+    if victim.exists() and any(victim.iterdir()):
+        _log(f"refusing to simulate: {victim} is not empty")
+        return 2
+    cfg = FileSimConfig(num_files=args.files, seed=args.seed)
+    seed_files(victim, cfg)
+    store = SnapshotStore(inc / "store")
+    manifest = store.snapshot(victim, snapshot_id="pre-attack")
+    _log(f"seeded {len(manifest.files)} files, snapshot 'pre-attack' taken")
+
+    t0 = time.time()
+    trace, encrypted = run_file_attack(victim, cfg)
+    (inc / "trace.jsonl").write_text(events_to_jsonl(trace.events, trace.strings))
+    (inc / "incident.json").write_text(json.dumps({
+        "created": time.time(),
+        "attack_family": trace.ground_truth.attack_family,
+        "target": str(victim),
+        "snapshot_id": "pre-attack",
+        "files_encrypted": len(encrypted),
+        "attack_seconds": round(time.time() - t0, 3),
+    }, indent=2))
+    _log(f"attack complete: {len(encrypted)} files encrypted, trace written")
+    return 0
+
+
+# --------------------------------------------------------------------------
+def cmd_train_detector(args) -> int:
+    from nerrf_tpu.data import make_corpus
+    from nerrf_tpu.graph import GraphConfig
+    from nerrf_tpu.models import GraphSAGEConfig, JointConfig, LSTMConfig
+    from nerrf_tpu.train import TrainConfig, build_dataset, train_nerrfnet
+    from nerrf_tpu.train.checkpoint import save_checkpoint
+    from nerrf_tpu.train.data import DatasetConfig
+
+    model_cfg = JointConfig(
+        gnn=GraphSAGEConfig(hidden=args.hidden, num_layers=args.layers, dropout=0.05),
+        lstm=LSTMConfig(hidden=args.hidden, num_layers=1, dropout=0.05),
+    )
+    n_eval = max(2, args.traces // 4)
+    if args.traces < n_eval + 4:
+        _log(f"--traces must be ≥ {n_eval + 4} (need {n_eval} eval + ≥4 train runs)")
+        return 2
+    corpus = make_corpus(args.traces, attack_fraction=0.5, base_seed=args.seed,
+                         duration_sec=150.0, num_target_files=8, benign_rate_hz=25.0)
+    ds_cfg = DatasetConfig(graph=GraphConfig(max_nodes=256, max_edges=512),
+                           seq_len=100, max_seqs=128)
+    train_ds = build_dataset(corpus[:-n_eval], ds_cfg)
+    eval_ds = build_dataset(corpus[-n_eval:], ds_cfg)
+    _log(f"training detector on {len(train_ds)} windows ({args.steps} steps)…")
+    res = train_nerrfnet(train_ds, eval_ds, TrainConfig(
+        model=model_cfg, batch_size=8, num_steps=args.steps,
+        learning_rate=3e-3, warmup_steps=min(30, args.steps // 5)), log=_log)
+    _log(f"metrics: edge_auc={res.metrics['edge_auc']:.4f} "
+         f"seq_f1={res.metrics['seq_f1']:.4f} ({res.steps_per_sec:.1f} steps/s)")
+    save_checkpoint(args.model_dir, res.state.params, model_cfg)
+    _log(f"checkpoint saved to {args.model_dir}")
+    return 0 if res.metrics["edge_auc"] >= 0.9 else 1
+
+
+# --------------------------------------------------------------------------
+def cmd_undo(args) -> int:
+    from nerrf_tpu.data.loaders import load_trace_jsonl
+    from nerrf_tpu.pipeline import build_undo_domain, heuristic_detect, model_detect
+    from nerrf_tpu.planner import MCTSConfig, MCTSPlanner
+    from nerrf_tpu.planner.value_net import ValueNet
+    from nerrf_tpu.rollback import RollbackExecutor, SandboxGate, SnapshotStore
+
+    inc = Path(args.incident)
+    meta = json.loads((inc / "incident.json").read_text())
+    victim = Path(meta["target"])
+    t_start = time.perf_counter()
+
+    trace = load_trace_jsonl(inc / "trace.jsonl")
+    store = SnapshotStore(inc / "store")
+    manifest = store.load_manifest(meta["snapshot_id"])
+
+    # --- detect -------------------------------------------------------------
+    if args.model_dir:
+        from nerrf_tpu.models import NerrfNet
+        from nerrf_tpu.train.checkpoint import load_checkpoint
+
+        params, model_cfg = load_checkpoint(args.model_dir)
+        detection = model_detect(trace, params, NerrfNet(model_cfg))
+    else:
+        detection = heuristic_detect(trace)
+    flagged = detection.flagged_files()
+    _log(f"detect[{detection.detector}]: {len(flagged)}/{len(detection.file_scores)} "
+         f"files flagged, {sum(1 for v in detection.proc_scores.values() if v > 0.5)} "
+         "processes flagged")
+
+    # --- plan ---------------------------------------------------------------
+    domain = build_undo_domain(detection, manifest, root=str(victim))
+    value = ValueNet.create()
+    value.fit_to_domain(domain, num_rollouts=256, horizon=32, steps=200)
+    plan = MCTSPlanner(domain, value, MCTSConfig(
+        num_simulations=args.simulations)).plan()
+    (inc / "plan.json").write_text(json.dumps(plan.to_dict(), indent=2))
+    _log(f"plan: {len(plan.actions)} actions, {plan.rollouts} rollouts "
+         f"@ {plan.rollouts_per_sec:.0f}/s")
+
+    # --- sandbox gate -------------------------------------------------------
+    if not args.no_gate:
+        gate = SandboxGate(store, manifest).rehearse(plan, victim)
+        (inc / "gate.json").write_text(json.dumps(gate.to_dict(), indent=2))
+        _log(f"sandbox gate: approved={gate.approved} ({gate.reason})")
+        if not gate.approved:
+            return 3
+
+    if args.dry_run:
+        _log("dry run: stopping before execution")
+        return 0
+
+    # --- execute ------------------------------------------------------------
+    ex = RollbackExecutor(store, manifest, victim)
+    report = ex.execute(plan)
+    mttr = time.perf_counter() - t_start
+    out = report.to_dict()
+    out["mttr_seconds"] = round(mttr, 3)
+    (inc / "report.json").write_text(json.dumps(out, indent=2))
+    _log(f"rollback: {report.files_restored} files restored "
+         f"({report.mb_per_sec:.0f} MB/s), verified={report.verified}, "
+         f"MTTR={mttr:.2f}s")
+    return 0 if report.verified else 4
+
+
+# --------------------------------------------------------------------------
+def cmd_status(args) -> int:
+    inc = Path(args.incident)
+    stages = {
+        "incident": inc / "incident.json",
+        "plan": inc / "plan.json",
+        "gate": inc / "gate.json",
+        "report": inc / "report.json",
+    }
+    out = {}
+    for name, p in stages.items():
+        out[name] = json.loads(p.read_text()) if p.exists() else None
+    state = (
+        "recovered" if out["report"] and out["report"].get("verified")
+        else "planned" if out["plan"]
+        else "attacked" if out["incident"]
+        else "empty"
+    )
+    print(json.dumps({"state": state, **out}, indent=2))
+    return 0
+
+
+# --------------------------------------------------------------------------
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="nerrf", description=__doc__)
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("simulate", help="seed victim files, snapshot, run attack")
+    p.add_argument("--incident", required=True)
+    p.add_argument("--files", type=int, default=45)
+    p.add_argument("--seed", type=int, default=0)
+    p.set_defaults(fn=cmd_simulate)
+
+    p = sub.add_parser("train-detector", help="train + checkpoint a detector")
+    p.add_argument("--model-dir", required=True)
+    p.add_argument("--steps", type=int, default=300)
+    p.add_argument("--traces", type=int, default=12)
+    p.add_argument("--hidden", type=int, default=48)
+    p.add_argument("--layers", type=int, default=4)
+    p.add_argument("--seed", type=int, default=21)
+    p.set_defaults(fn=cmd_train_detector)
+
+    p = sub.add_parser("undo", help="detect, plan, rehearse and roll back")
+    p.add_argument("--incident", required=True)
+    p.add_argument("--model-dir", default=None,
+                   help="trained detector checkpoint (default: heuristic)")
+    p.add_argument("--simulations", type=int, default=800)
+    p.add_argument("--dry-run", action="store_true")
+    p.add_argument("--no-gate", action="store_true")
+    p.set_defaults(fn=cmd_undo)
+
+    p = sub.add_parser("status", help="incident state")
+    p.add_argument("--incident", required=True)
+    p.set_defaults(fn=cmd_status)
+
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -152,10 +152,14 @@ def _lower_sim_record(rec: dict, inodes: InodeTable) -> dict:
     # Records carrying a real inode pin it in the table too, so mixed traces
     # (some lines with inodes, some without) still resolve one file per inode.
     src, dst = out.get("path", ""), out.get("new_path", "")
-    if "inode" in rec:
-        out["inode"] = int(rec.get("inode", 0) or 0)
-        inodes.register(src, out["inode"], dst)
+    real_inode = int(rec.get("inode", 0) or 0)
+    if real_inode:
+        out["inode"] = real_inode
+        inodes.register(src, real_inode, dst)
     else:
+        # absent OR zero inode → synthesize (an eBPF capture that failed to
+        # resolve the inode reports 0, which must not collapse all files
+        # into "no file")
         out["inode"] = inodes.carry_rename(src, dst) if dst else inodes.get(src)
     return out
 

@@ -76,7 +76,10 @@ class UndoPlan:
 
 # Cost model constants, following the worked example's relative costs
 # (threat-model.mdx:205-223) on the README reward scale.
-FP_REVERT_COST_MB = 8.0       # side effect of reverting a clean file
+# Reverting a clean file loses whatever legitimate changes happened since the
+# snapshot — proportional to the file itself, plus a fixed disruption floor.
+FP_REVERT_SCALE = 2.0
+FP_REVERT_FLOOR_MB = 0.05
 KILL_DOWNTIME_SEC = 30.0      # service disruption of killing a process
 REVERT_SECONDS_PER_MB = 0.05  # reverse-diff apply rate
 ONGOING_LOSS_MB_PER_SEC = 2.0  # active encryptor destroys ~2 MB/s (M1 rate)
@@ -153,8 +156,9 @@ class UndoDomain:
             sc = self.file_scores[i]
             loss = self.file_loss_mb[i]
             t_op = REVERT_SECONDS_PER_MB * loss
+            fp_cost = FP_REVERT_SCALE * loss + FP_REVERT_FLOOR_MB
             reward[is_file] = (
-                sc * loss - (1 - sc) * FP_REVERT_COST_MB - DOWNTIME_WEIGHT * t_op
+                sc * loss - (1 - sc) * fp_cost - DOWNTIME_WEIGHT * t_op
             )
             s[is_file, i] = 1.0
             s[is_file, F + P] += t_op
@@ -195,7 +199,8 @@ class UndoDomain:
     # --- priors + value features --------------------------------------------
     def priors(self) -> np.ndarray:
         """Action priors from detector scores (softmax over expected gain)."""
-        gain_f = self.file_scores * self.file_loss_mb - (1 - self.file_scores) * FP_REVERT_COST_MB
+        fp_cost = FP_REVERT_SCALE * self.file_loss_mb + FP_REVERT_FLOOR_MB
+        gain_f = self.file_scores * self.file_loss_mb - (1 - self.file_scores) * fp_cost
         gain_p = self.proc_scores * ONGOING_LOSS_MB_PER_SEC * 30.0 - 3.0
         logits = np.concatenate([gain_f, gain_p, np.zeros(1)]) / 8.0
         e = np.exp(logits - logits.max())
@@ -206,7 +211,7 @@ class UndoDomain:
         of F/P so one net serves every incident size)."""
         done_f, killed_p, downtime, steps, stopped = self.split(s)
         rem_gain = ((1 - done_f) * self.file_scores * self.file_loss_mb).sum(-1)
-        rem_fp = ((1 - done_f) * (1 - self.file_scores)).sum(-1)
+        rem_fp = ((1 - done_f) * (1 - self.file_scores)).sum(-1)  # count-scale FP exposure
         live = (self.proc_scores * (killed_p < 0.5)).sum(-1)
         return np.stack(
             [
@@ -226,7 +231,7 @@ class UndoDomain:
         """Per-action expected incremental reward from the initial state [A]."""
         gain_f = (
             self.file_scores * self.file_loss_mb
-            - (1 - self.file_scores) * FP_REVERT_COST_MB
+            - (1 - self.file_scores) * (FP_REVERT_SCALE * self.file_loss_mb + FP_REVERT_FLOOR_MB)
             - DOWNTIME_WEIGHT * REVERT_SECONDS_PER_MB * self.file_loss_mb
         )
         gain_p = (

@@ -1,0 +1,15 @@
+from nerrf_tpu.rollback.store import SnapshotStore, Manifest
+from nerrf_tpu.rollback.executor import RollbackExecutor, RollbackReport
+from nerrf_tpu.rollback.sandbox import SandboxGate, GateResult
+from nerrf_tpu.rollback.filesim import FileSimConfig, run_file_attack
+
+__all__ = [
+    "SnapshotStore",
+    "Manifest",
+    "RollbackExecutor",
+    "RollbackReport",
+    "SandboxGate",
+    "GateResult",
+    "FileSimConfig",
+    "run_file_attack",
+]

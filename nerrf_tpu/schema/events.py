@@ -154,17 +154,26 @@ class InodeTable:
     path — the invariant behind the reference's "node merging (inode
     deduplication)" (`architecture.mdx:39`).  Shared by the trace loaders and
     the synthetic generator so the policy cannot drift between them.
+
+    Synthetic ids live in a range (≥ 2^48) that real filesystem inodes do not
+    reach in practice, so mixed traces (some records carrying real inodes,
+    some not) cannot collide two distinct files onto one id.
     """
 
-    _BASE = 1000
+    SYNTHETIC_BASE = 1 << 48
 
     def __init__(self) -> None:
         self._of: dict[str, int] = {}
+        self._next = self.SYNTHETIC_BASE
 
     def get(self, path: str) -> int:
         if not path:
             return 0
-        return self._of.setdefault(path, self._BASE + len(self._of))
+        got = self._of.get(path)
+        if got is None:
+            got = self._of[path] = self._next
+            self._next += 1
+        return got
 
     def carry_rename(self, src: str, dst: str) -> int:
         """Record src→dst rename; returns the carried inode."""

@@ -1,0 +1,47 @@
+"""Model checkpoint save/restore (orbax).
+
+The reference has no model checkpointing (no models existed; SURVEY.md §5).
+Here: standard orbax checkpoints of the param pytree plus a JSON sidecar with
+the model config, so a checkpoint is self-describing and `nerrf undo
+--model-dir` can reconstruct the exact network.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from pathlib import Path
+from typing import Tuple
+
+import jax
+import orbax.checkpoint as ocp
+
+from nerrf_tpu.models import GraphSAGEConfig, JointConfig, LSTMConfig
+
+
+def save_checkpoint(path: str | Path, params, cfg: JointConfig) -> None:
+    path = Path(path).absolute()
+    path.mkdir(parents=True, exist_ok=True)
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(path / "params", jax.device_get(params), force=True)
+    meta = {
+        "gnn": {"hidden": cfg.gnn.hidden, "num_layers": cfg.gnn.num_layers,
+                "dropout": cfg.gnn.dropout},
+        "lstm": {"hidden": cfg.lstm.hidden, "num_layers": cfg.lstm.num_layers,
+                 "dropout": cfg.lstm.dropout},
+        "fuse": cfg.fuse,
+    }
+    (path / "model_config.json").write_text(json.dumps(meta, indent=2))
+
+
+def load_checkpoint(path: str | Path) -> Tuple[dict, JointConfig]:
+    path = Path(path).absolute()
+    meta = json.loads((path / "model_config.json").read_text())
+    cfg = JointConfig(
+        gnn=GraphSAGEConfig(**meta["gnn"]),
+        lstm=LSTMConfig(**meta["lstm"]),
+        fuse=meta["fuse"],
+    )
+    with ocp.StandardCheckpointer() as ckptr:
+        params = ckptr.restore(path / "params")
+    return params, cfg

@@ -1,0 +1,45 @@
+import json
+
+import pytest
+
+from nerrf_tpu.cli import main
+
+
+@pytest.mark.slow
+def test_cli_full_incident_lifecycle(tmp_path, capsys):
+    inc = str(tmp_path / "inc")
+    assert main(["simulate", "--incident", inc, "--files", "6"]) == 0
+    # refuse double-simulate over a populated victim
+    assert main(["simulate", "--incident", inc, "--files", "6"]) == 2
+
+    # status: attacked
+    assert main(["status", "--incident", inc]) == 0
+    st = json.loads(capsys.readouterr().out)
+    assert st["state"] == "attacked"
+    assert st["incident"]["files_encrypted"] == 6
+
+    # dry run plans + gates but does not touch the victim
+    assert main(["undo", "--incident", inc, "--dry-run", "--simulations", "200"]) == 0
+    assert (tmp_path / "inc" / "plan.json").exists()
+    assert (tmp_path / "inc" / "gate.json").exists()
+    assert not (tmp_path / "inc" / "report.json").exists()
+    victim = tmp_path / "inc" / "victim"
+    assert len(list(victim.glob("*.lockbit3"))) == 6
+
+    # real undo
+    assert main(["undo", "--incident", inc, "--simulations", "200"]) == 0
+    report = json.loads((tmp_path / "inc" / "report.json").read_text())
+    assert report["verified"] and report["files_restored"] == 6
+    assert report["mttr_seconds"] < 600
+    assert len(list(victim.glob("*.dat"))) == 6
+    assert not list(victim.glob("*.lockbit3"))
+
+    assert main(["status", "--incident", inc]) == 0
+    st = json.loads(capsys.readouterr().out)
+    assert st["state"] == "recovered"
+
+
+def test_cli_status_empty(tmp_path, capsys):
+    assert main(["status", "--incident", str(tmp_path / "nothing")]) == 0
+    st = json.loads(capsys.readouterr().out)
+    assert st["state"] == "empty"

@@ -1,0 +1,177 @@
+import numpy as np
+import pytest
+
+from nerrf_tpu.pipeline import build_undo_domain, heuristic_detect
+from nerrf_tpu.planner import MCTSConfig, MCTSPlanner
+from nerrf_tpu.planner.domain import ActionKind, UndoAction, UndoPlan
+from nerrf_tpu.planner.value_net import HeuristicValue
+from nerrf_tpu.rollback import (
+    FileSimConfig,
+    RollbackExecutor,
+    SandboxGate,
+    SnapshotStore,
+    run_file_attack,
+)
+from nerrf_tpu.rollback.filesim import seed_files
+from nerrf_tpu.rollback.sandbox import FirecrackerDriver
+
+CFG = FileSimConfig(num_files=6, min_file_bytes=4096, max_file_bytes=16384)
+
+
+def _plan_for(paths, scores=0.95):
+    return UndoPlan(
+        actions=[UndoAction(ActionKind.REVERT_FILE, str(p), scores) for p in paths],
+        expected_reward=1.0, rollouts=0, rollouts_per_sec=0.0, planning_seconds=0.0,
+    )
+
+
+def test_snapshot_store_roundtrip(tmp_path):
+    victim = tmp_path / "v"
+    seed_files(victim, CFG)
+    store = SnapshotStore(tmp_path / "store")
+    m = store.snapshot(victim, "s1")
+    assert len(m.files) == 6
+    assert store.list_manifests() == ["s1"]
+    # mutate a file → diff sees it, restore fixes it bit-exactly
+    target = next(victim.glob("*.dat"))
+    orig = target.read_bytes()
+    target.write_bytes(b"corrupted")
+    rel = target.name
+    assert store.diff(m, victim) == {rel: "modified"}
+    store.restore_file(m, rel, victim)
+    assert target.read_bytes() == orig
+    assert store.verify_file(m, rel, victim)
+    assert store.diff(m, victim) == {}
+    # manifest json roundtrip
+    m2 = store.load_manifest("s1")
+    assert m2.files == m.files
+
+
+def test_file_attack_destroys_and_traces(tmp_path):
+    victim = tmp_path / "v"
+    seed_files(victim, CFG)
+    originals = {p.name: p.read_bytes() for p in victim.glob("*.dat")}
+    trace, encrypted = run_file_attack(victim, CFG)
+    assert len(encrypted) == 6
+    assert not list(victim.glob("*.dat"))  # all renamed
+    for enc in encrypted:
+        orig_name = enc.name[: -len(CFG.ransom_ext)]
+        assert enc.read_bytes() != originals[orig_name]  # content destroyed
+    # trace carries the attack at syscall granularity with inodes
+    assert trace.events.num_valid > 30
+    assert (trace.events.inode > 0).sum() > 0
+    assert trace.labels.min() == 1.0  # attack-only trace
+
+
+def test_executor_restores_and_verifies(tmp_path):
+    victim = tmp_path / "v"
+    seed_files(victim, CFG)
+    store = SnapshotStore(tmp_path / "store")
+    m = store.snapshot(victim, "pre")
+    originals = {p.name: p.read_bytes() for p in victim.glob("*.dat")}
+    _, encrypted = run_file_attack(victim, CFG)
+
+    rep = RollbackExecutor(store, m, victim).execute(_plan_for(encrypted))
+    assert rep.files_restored == 6 and rep.files_failed == 0
+    assert rep.verified
+    for name, data in originals.items():
+        assert (victim / name).read_bytes() == data
+    assert not list(victim.glob(f"*{CFG.ransom_ext}"))  # artifacts removed
+
+
+def test_executor_skips_unknown_targets(tmp_path):
+    victim = tmp_path / "v"
+    seed_files(victim, CFG)
+    store = SnapshotStore(tmp_path / "store")
+    m = store.snapshot(victim, "pre")
+    rep = RollbackExecutor(store, m, victim).execute(
+        _plan_for(["/nowhere/ghost.lockbit3"])
+    )
+    assert rep.files_skipped == 1 and rep.files_restored == 0
+    assert not rep.verified
+
+
+def test_sandbox_gate_approves_good_plan_and_leaves_victim_untouched(tmp_path):
+    victim = tmp_path / "v"
+    seed_files(victim, CFG)
+    store = SnapshotStore(tmp_path / "store")
+    m = store.snapshot(victim, "pre")
+    _, encrypted = run_file_attack(victim, CFG)
+    before = sorted(p.name for p in victim.iterdir())
+
+    gate = SandboxGate(store, m).rehearse(_plan_for(encrypted), victim)
+    assert gate.approved, gate.reason
+    assert gate.rehearsal.files_restored == 6
+    # rehearsal ran on a clone: victim still encrypted
+    assert sorted(p.name for p in victim.iterdir()) == before
+
+
+def test_sandbox_gate_rejects_incomplete_plan(tmp_path):
+    victim = tmp_path / "v"
+    seed_files(victim, CFG)
+    store = SnapshotStore(tmp_path / "store")
+    m = store.snapshot(victim, "pre")
+    _, encrypted = run_file_attack(victim, CFG)
+    gate = SandboxGate(store, m).rehearse(_plan_for(encrypted[:2]), victim)
+    assert not gate.approved
+    assert len(gate.residual_diff) > 0
+
+
+def test_sandbox_gate_handles_nested_victim_layout(tmp_path):
+    """Plan targets are absolute paths under the original victim; the gate
+    executes against a clone at a different root — suffix matching must still
+    resolve nested manifest keys."""
+    victim = tmp_path / "v"
+    sub = victim / "sub" / "deep"
+    sub.mkdir(parents=True)
+    (sub / "a.dat").write_bytes(b"alpha" * 1000)
+    (victim / "b.dat").write_bytes(b"beta" * 1000)
+    store = SnapshotStore(tmp_path / "store")
+    m = store.snapshot(victim, "pre")
+    assert "sub/deep/a.dat" in m.files
+    # encrypt both by hand
+    for p, rel in ((sub / "a.dat", "sub/deep/a.dat"), (victim / "b.dat", "b.dat")):
+        p.write_bytes(b"X" * 100)
+        p.rename(p.with_suffix(".dat.lockbit3"))
+    plan = _plan_for([
+        str(sub / "a.dat.lockbit3"), str(victim / "b.dat.lockbit3")
+    ])
+    gate = SandboxGate(store, m).rehearse(plan, victim)
+    assert gate.approved, (gate.reason, gate.residual_diff)
+    rep = RollbackExecutor(store, m, victim).execute(plan)
+    assert rep.files_restored == 2 and rep.verified
+    assert (sub / "a.dat").read_bytes() == b"alpha" * 1000
+
+
+def test_firecracker_driver_gated():
+    assert not FirecrackerDriver.available()  # no KVM in this container
+    with pytest.raises(RuntimeError):
+        FirecrackerDriver().rehearse()
+
+
+def test_pipeline_detect_and_domain(tmp_path):
+    victim = tmp_path / "v"
+    seed_files(victim, CFG)
+    store = SnapshotStore(tmp_path / "store")
+    m = store.snapshot(victim, "pre")
+    trace, encrypted = run_file_attack(victim, CFG)
+    det = heuristic_detect(trace)
+    flagged = det.flagged_files()
+    # every encrypted file flagged high
+    for enc in encrypted:
+        assert det.file_scores.get(str(enc), 0) >= 0.9
+    # the attacking process flagged
+    assert max(det.proc_scores.values()) > 0.9
+    domain = build_undo_domain(det, m, root=str(victim))
+    assert domain.F >= 6
+    # manifest-derived loss is the real file size (up to the 0.01 MB floor)
+    loss_of = dict(zip(domain.file_paths, domain.file_loss_mb))
+    for enc in encrypted:
+        rel = enc.name[: -len(CFG.ransom_ext)]
+        expected = max(m.files[rel][1] / 1e6, 0.01)
+        assert abs(loss_of[str(enc)] - expected) < 1e-6
+
+    plan = MCTSPlanner(domain, HeuristicValue(),
+                       MCTSConfig(num_simulations=200, batch_size=16)).plan()
+    targets = {a.target for a in plan.actions}
+    assert {str(e) for e in encrypted} <= targets
