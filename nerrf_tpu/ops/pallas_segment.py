@@ -1,0 +1,217 @@
+"""Hand-tiled Pallas TPU kernels for sparse neighbor aggregation.
+
+The reference framework never built its AI subsystem, so it has no sparse ops;
+the north star requires neighbor aggregation and sampling gathers as Pallas
+kernels (SURVEY.md §7 step 2).  On TPU the fastest formulation of a segment
+reduction at our graph sizes (N ≤ a few thousand nodes, E ≤ a few thousand
+edges, F ≤ 512 features) is *not* a scatter at all — scatters serialize on the
+VPU — but a one-hot contraction that rides the 128×128 MXU:
+
+    out[n, f] = Σ_e [seg_ids[e] == n] · data[e, f]
+
+i.e. ``onehotᵀ @ data``.  The kernel tiles (segments × features) over the grid
+and accumulates over edge tiles, building each one-hot block in VMEM with a
+broadcasted iota compare (never materializing the full [E, N] matrix in HBM).
+The same trick gives the row gather ``table[idx]`` as ``onehot @ table``.
+
+Both kernels are order-independent (no sorted-ids requirement) and carry
+custom VJPs — the adjoint of a segment-sum is a row gather and vice versa, so
+the backward passes reuse the same two kernels.
+
+Use :func:`register` to install these as the implementation behind
+``nerrf_tpu.ops.segment_sum`` / ``gather_rows``; ``segment.py`` auto-registers
+on first use when the active backend is TPU (opt out: ``NERRF_NO_PALLAS=1``).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# Tile sizes: lane dim is always 128; 128 edge rows per accumulation step
+# keeps the one-hot block square on the MXU.
+_TN = 128  # segment (output-row) tile
+_TE = 128  # edge (contraction) tile
+_TF = 128  # feature tile
+
+
+def _pad_to(x: jnp.ndarray, axis: int, mult: int, value) -> jnp.ndarray:
+    size = x.shape[axis]
+    rem = (-size) % mult
+    if rem == 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, rem)
+    return jnp.pad(x, widths, constant_values=value)
+
+
+# --- segment sum -------------------------------------------------------------
+
+
+def _segment_sum_kernel(ids_ref, data_ref, out_ref):
+    k = pl.program_id(2)
+
+    @pl.when(k == 0)
+    def _():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    seg_base = pl.program_id(0) * _TN
+    ids = ids_ref[:]  # [TE, 1] int32
+    cols = jax.lax.broadcasted_iota(jnp.int32, (_TE, _TN), 1) + seg_base
+    onehot = (ids == cols).astype(jnp.float32)  # [TE, TN]
+    out_ref[:] += jax.lax.dot_general(
+        onehot,
+        data_ref[:].astype(jnp.float32),
+        dimension_numbers=(((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+
+def _segment_sum_call(
+    data: jnp.ndarray,
+    segment_ids: jnp.ndarray,
+    num_segments: int,
+    *,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    E, F = data.shape
+    ids = _pad_to(segment_ids.astype(jnp.int32).reshape(-1, 1), 0, _TE, -1)
+    dat = _pad_to(_pad_to(data, 0, _TE, 0), 1, _TF, 0)
+    n_pad = num_segments + ((-num_segments) % _TN)
+    Ep, Fp = dat.shape
+
+    grid = (n_pad // _TN, Fp // _TF, Ep // _TE)
+    out = pl.pallas_call(
+        _segment_sum_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((_TE, 1), lambda i, j, k: (k, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((_TE, _TF), lambda i, j, k: (k, j), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (_TN, _TF), lambda i, j, k: (i, j), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((n_pad, Fp), jnp.float32),
+        cost_estimate=pl.CostEstimate(
+            flops=2 * Ep * n_pad * Fp,
+            bytes_accessed=4 * (Ep * Fp + n_pad * Fp) + 4 * Ep,
+            transcendentals=0,
+        ),
+        interpret=interpret,
+    )(ids, dat)
+    return out[:num_segments, :F].astype(data.dtype)
+
+
+# --- row gather --------------------------------------------------------------
+
+
+def _gather_kernel(idx_ref, table_ref, out_ref):
+    k = pl.program_id(2)
+
+    @pl.when(k == 0)
+    def _():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    row_base = pl.program_id(2) * _TN
+    idx = idx_ref[:]  # [TE, 1] int32
+    cols = jax.lax.broadcasted_iota(jnp.int32, (_TE, _TN), 1) + row_base
+    onehot = (idx == cols).astype(jnp.float32)  # [TE, TN]
+    out_ref[:] += jnp.dot(
+        onehot, table_ref[:].astype(jnp.float32), preferred_element_type=jnp.float32
+    )
+
+
+def _gather_call(
+    table: jnp.ndarray, idx: jnp.ndarray, *, interpret: bool = False
+) -> jnp.ndarray:
+    N, F = table.shape
+    E = idx.shape[0]
+    ids = _pad_to(idx.astype(jnp.int32).reshape(-1, 1), 0, _TE, -1)
+    tab = _pad_to(_pad_to(table, 0, _TN, 0), 1, _TF, 0)
+    Ep = ids.shape[0]
+    Np, Fp = tab.shape
+
+    grid = (Ep // _TE, Fp // _TF, Np // _TN)
+    out = pl.pallas_call(
+        _gather_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((_TE, 1), lambda i, j, k: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((_TN, _TF), lambda i, j, k: (k, j), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (_TE, _TF), lambda i, j, k: (i, j), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((Ep, Fp), jnp.float32),
+        cost_estimate=pl.CostEstimate(
+            flops=2 * Ep * Np * Fp,
+            bytes_accessed=4 * (Np * Fp + Ep * Fp) + 4 * Ep,
+            transcendentals=0,
+        ),
+        interpret=interpret,
+    )(ids, tab)
+    return out[:E, :F].astype(table.dtype)
+
+
+# --- custom VJPs (adjoint of sum is gather, and vice versa) ------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def segment_sum(data, segment_ids, num_segments, interpret=False):
+    """MXU one-hot segment-sum: rows of ``data`` [E, F] → buckets [N, F]."""
+    return _segment_sum_call(data, segment_ids, num_segments, interpret=interpret)
+
+
+def _segment_sum_fwd(data, segment_ids, num_segments, interpret):
+    return _segment_sum_call(data, segment_ids, num_segments, interpret=interpret), (
+        segment_ids,
+    )
+
+
+def _segment_sum_bwd(num_segments, interpret, res, g):
+    (segment_ids,) = res
+    return _gather_call(g, segment_ids, interpret=interpret), None
+
+
+segment_sum.defvjp(_segment_sum_fwd, _segment_sum_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def gather_rows(table, idx, interpret=False):
+    """MXU one-hot row gather: ``table[idx]`` without an XLA scatter/gather."""
+    return _gather_call(table, idx, interpret=interpret)
+
+
+def _gather_fwd(table, idx, interpret):
+    return _gather_call(table, idx, interpret=interpret), (idx, table.shape[0])
+
+
+def _gather_bwd(interpret, res, g):
+    idx, num_rows = res
+    return _segment_sum_call(g, idx, num_rows, interpret=interpret), None
+
+
+gather_rows.defvjp(_gather_fwd, _gather_bwd)
+
+
+# --- registration ------------------------------------------------------------
+
+
+def register(interpret: bool = False) -> None:
+    """Install the Pallas kernels behind ``nerrf_tpu.ops``' switchboard."""
+    from nerrf_tpu.ops import segment as _seg
+
+    _seg.use_pallas(
+        lambda data, ids, n: segment_sum(data, ids, n, interpret),
+        lambda table, idx: gather_rows(table, idx, interpret),
+    )
+
+
+def unregister() -> None:
+    from nerrf_tpu.ops import segment as _seg
+
+    _seg.use_pallas(None, None)

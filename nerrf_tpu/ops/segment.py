@@ -14,19 +14,44 @@ sparse aggregation be written as Pallas kernels.)
 
 from __future__ import annotations
 
+import os
 from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
 
-# Optional override installed by nerrf_tpu.ops.pallas_segment.register().
+# Optional overrides installed by nerrf_tpu.ops.pallas_segment.register().
 _SEGMENT_SUM_IMPL: Optional[Callable] = None
+_GATHER_IMPL: Optional[Callable] = None
+_AUTO_TRIED = False
 
 
-def use_pallas(fn: Optional[Callable]) -> None:
-    """Install (or clear) a pallas segment-sum implementation."""
-    global _SEGMENT_SUM_IMPL
-    _SEGMENT_SUM_IMPL = fn
+def use_pallas(sum_fn: Optional[Callable], gather_fn: Optional[Callable] = None) -> None:
+    """Install (or clear) pallas segment-sum / row-gather implementations.
+
+    An explicit call — including clearing — is a deliberate choice, so it also
+    disables the one-shot TPU auto-probe in :func:`_maybe_auto_register`.
+    """
+    global _SEGMENT_SUM_IMPL, _GATHER_IMPL, _AUTO_TRIED
+    _SEGMENT_SUM_IMPL = sum_fn
+    _GATHER_IMPL = gather_fn
+    _AUTO_TRIED = True
+
+
+def _maybe_auto_register() -> None:
+    """On the first aggregation call, swap in the Pallas kernels iff we are
+    actually on a TPU backend (opt out with NERRF_NO_PALLAS=1).  Deferred to
+    call time so importing the library never forces backend initialization."""
+    global _AUTO_TRIED
+    if _AUTO_TRIED or _SEGMENT_SUM_IMPL is not None:
+        return
+    _AUTO_TRIED = True
+    if os.environ.get("NERRF_NO_PALLAS") == "1":
+        return
+    if jax.default_backend() == "tpu":
+        from nerrf_tpu.ops import pallas_segment
+
+        pallas_segment.register()
 
 
 def segment_sum(
@@ -37,7 +62,15 @@ def segment_sum(
     sorted_ids: bool = True,
 ) -> jnp.ndarray:
     """Sum rows of ``data`` [E, F] into ``num_segments`` buckets [N, F]."""
-    if _SEGMENT_SUM_IMPL is not None and sorted_ids and data.ndim == 2:
+    _maybe_auto_register()
+    # The Pallas one-hot contraction is order-independent — no sortedness
+    # requirement (see pallas_segment.py) — but it computes through f32, so
+    # integer data keeps the exact XLA path.
+    if (
+        _SEGMENT_SUM_IMPL is not None
+        and data.ndim == 2
+        and jnp.issubdtype(data.dtype, jnp.floating)
+    ):
         return _SEGMENT_SUM_IMPL(data, segment_ids, num_segments)
     return jax.ops.segment_sum(
         data, segment_ids, num_segments=num_segments, indices_are_sorted=sorted_ids
@@ -69,4 +102,12 @@ def segment_mean(
 def gather_rows(table: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
     """Row gather ``table[idx]`` — kept as a named op so the Pallas blocked
     gather can swap in on TPU without touching call sites."""
+    _maybe_auto_register()
+    if (
+        _GATHER_IMPL is not None
+        and table.ndim == 2
+        and idx.ndim == 1
+        and jnp.issubdtype(table.dtype, jnp.floating)
+    ):
+        return _GATHER_IMPL(table, idx)
     return jnp.take(table, idx, axis=0)

@@ -1,0 +1,107 @@
+"""Pallas sparse-aggregation kernels vs the XLA reference path.
+
+Runs in interpreter mode on the CPU mesh (tests/conftest.py); the compiled
+path is exercised on real TPU by bench.py.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from nerrf_tpu.ops import pallas_segment, segment
+
+
+@pytest.fixture(autouse=True)
+def _clean_switchboard():
+    yield
+    pallas_segment.unregister()  # also disables the TPU auto-probe
+
+
+def _rand(shape, seed):
+    return jnp.asarray(np.random.default_rng(seed).normal(size=shape), jnp.float32)
+
+
+@pytest.mark.parametrize("E,N,F", [(37, 11, 5), (128, 128, 128), (300, 50, 33)])
+@pytest.mark.parametrize("sorted_ids", [True, False])
+def test_segment_sum_matches_xla(E, N, F, sorted_ids):
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, N, size=E)
+    if sorted_ids:
+        ids = np.sort(ids)
+    ids = jnp.asarray(ids, jnp.int32)
+    data = _rand((E, F), 1)
+
+    got = pallas_segment.segment_sum(data, ids, N, True)
+    want = jax.ops.segment_sum(data, ids, num_segments=N)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_segment_sum_empty_segments_are_zero():
+    ids = jnp.asarray([0, 0, 3], jnp.int32)
+    data = jnp.ones((3, 4), jnp.float32)
+    out = pallas_segment.segment_sum(data, ids, 6, True)
+    np.testing.assert_allclose(out[1], 0.0)
+    np.testing.assert_allclose(out[0], 2.0)
+    np.testing.assert_allclose(out[3], 1.0)
+    np.testing.assert_allclose(out[4:], 0.0)
+
+
+def test_gather_rows_matches_take():
+    table = _rand((45, 19), 2)
+    idx = jnp.asarray(np.random.default_rng(3).integers(0, 45, size=130), jnp.int32)
+    got = pallas_segment.gather_rows(table, idx, True)
+    np.testing.assert_allclose(got, jnp.take(table, idx, axis=0), rtol=1e-5, atol=1e-6)
+
+
+def test_segment_sum_grad_is_gather():
+    ids = jnp.asarray([2, 0, 2, 1], jnp.int32)
+    data = _rand((4, 3), 4)
+
+    def loss(d):
+        out = pallas_segment.segment_sum(d, ids, 3, True)
+        return jnp.sum(out * out)
+
+    g = jax.grad(loss)(data)
+    want = jax.grad(
+        lambda d: jnp.sum(jax.ops.segment_sum(d, ids, num_segments=3) ** 2)
+    )(data)
+    np.testing.assert_allclose(g, want, rtol=1e-5, atol=1e-5)
+
+
+def test_gather_rows_grad_is_segment_sum():
+    table = _rand((6, 3), 5)
+    idx = jnp.asarray([5, 5, 0, 2], jnp.int32)
+
+    def loss(t):
+        return jnp.sum(pallas_segment.gather_rows(t, idx, True) ** 2)
+
+    g = jax.grad(loss)(table)
+    want = jax.grad(lambda t: jnp.sum(jnp.take(t, idx, axis=0) ** 2))(table)
+    np.testing.assert_allclose(g, want, rtol=1e-5, atol=1e-5)
+
+
+def test_switchboard_registration_routes_calls():
+    pallas_segment.register(interpret=True)
+    data = _rand((20, 7), 6)
+    ids = jnp.asarray(np.sort(np.random.default_rng(7).integers(0, 9, 20)), jnp.int32)
+    got = segment.segment_sum(data, ids, 9)
+    want = jax.ops.segment_sum(data, ids, num_segments=9)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+    table = _rand((9, 7), 8)
+    np.testing.assert_allclose(
+        segment.gather_rows(table, ids), jnp.take(table, ids, axis=0),
+        rtol=1e-5, atol=1e-6,
+    )
+
+
+def test_segment_mean_through_pallas_with_weights():
+    pallas_segment.register(interpret=True)
+    data = _rand((16, 5), 9)
+    w = jnp.abs(_rand((16,), 10)) + 0.1
+    ids = jnp.asarray(np.sort(np.random.default_rng(11).integers(0, 6, 16)), jnp.int32)
+    got = segment.segment_mean(data, ids, 6, weights=w)
+    tot = jax.ops.segment_sum(data * w[:, None], ids, num_segments=6)
+    den = jax.ops.segment_sum(w[:, None], ids, num_segments=6)
+    np.testing.assert_allclose(got, tot / jnp.maximum(den, 1e-6), rtol=1e-4, atol=1e-5)
