@@ -1,0 +1,65 @@
+/* Fixed-size binary event record shared by the eBPF programs, the capture
+ * daemon, and the ingest bridge.
+ *
+ * Layout parity: this is the same 568-byte object the reference kernel side
+ * emits (`/root/reference/tracker/bpf/tracepoints.c:18-28`), but with the
+ * compiler-inserted hole after `syscall_id` made explicit.  The reference's
+ * Go reader parses the packed 564-byte form and therefore reads ret_val and
+ * everything after it 4 bytes shifted (SURVEY.md §3.2); pinning the padded
+ * layout here — and static_asserting every offset — is the fix.
+ */
+#ifndef NERRF_EVENT_RECORD_H_
+#define NERRF_EVENT_RECORD_H_
+
+#include <stdint.h>
+#ifdef __cplusplus
+#include <cstddef>
+#endif
+
+#define NERRF_COMM_LEN 16
+#define NERRF_PATH_LEN 256
+
+/* Syscall identity codes carried in the record.  Must stay in sync with
+ * nerrf_tpu/schema/events.py::Syscall (the device-side embedding vocabulary).
+ */
+enum nerrf_syscall {
+  NERRF_SC_OPENAT = 0,
+  NERRF_SC_WRITE = 1,
+  NERRF_SC_RENAME = 2,
+  NERRF_SC_READ = 3,
+  NERRF_SC_UNLINK = 4,
+  NERRF_SC_CLOSE = 5,
+  NERRF_SC_EXEC = 6,
+  NERRF_SC_CONNECT = 7,
+  NERRF_SC_STAT = 8,
+  NERRF_SC_MKDIR = 9,
+  NERRF_SC_CHMOD = 10,
+  NERRF_SC_FSYNC = 11,
+  NERRF_SC_MARKER = 12,
+  NERRF_SC_OTHER = 13,
+};
+
+struct nerrf_event_record {
+  uint64_t ts_ns;      /* CLOCK_MONOTONIC at capture */
+  uint32_t pid;
+  uint32_t tid;
+  char comm[NERRF_COMM_LEN];
+  uint32_t syscall_id; /* enum nerrf_syscall */
+  uint32_t _pad;       /* explicit alignment hole — always zero */
+  int64_t ret_val;
+  uint64_t bytes;
+  char path[NERRF_PATH_LEN];
+  char new_path[NERRF_PATH_LEN];
+};
+
+#define NERRF_EVENT_RECORD_SIZE 568
+
+#ifdef __cplusplus
+static_assert(sizeof(struct nerrf_event_record) == NERRF_EVENT_RECORD_SIZE,
+              "event record must be exactly 568 bytes");
+static_assert(offsetof(nerrf_event_record, ret_val) == 40, "padded layout");
+static_assert(offsetof(nerrf_event_record, path) == 56, "padded layout");
+static_assert(offsetof(nerrf_event_record, new_path) == 312, "padded layout");
+#endif
+
+#endif /* NERRF_EVENT_RECORD_H_ */

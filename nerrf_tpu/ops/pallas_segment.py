@@ -79,6 +79,8 @@ def _segment_sum_call(
     interpret: bool = False,
 ) -> jnp.ndarray:
     E, F = data.shape
+    if E == 0 or F == 0 or num_segments == 0:  # degenerate: nothing to tile
+        return jnp.zeros((num_segments, F), data.dtype)
     ids = _pad_to(segment_ids.astype(jnp.int32).reshape(-1, 1), 0, _TE, -1)
     dat = _pad_to(_pad_to(data, 0, _TE, 0), 1, _TF, 0)
     n_pad = num_segments + ((-num_segments) % _TN)
@@ -130,6 +132,8 @@ def _gather_call(
 ) -> jnp.ndarray:
     N, F = table.shape
     E = idx.shape[0]
+    if E == 0 or F == 0 or N == 0:  # degenerate: nothing to tile
+        return jnp.zeros((E, F), table.dtype)
     ids = _pad_to(idx.astype(jnp.int32).reshape(-1, 1), 0, _TE, -1)
     tab = _pad_to(_pad_to(table, 0, _TN, 0), 1, _TF, 0)
     Ep = ids.shape[0]

@@ -105,3 +105,12 @@ def test_segment_mean_through_pallas_with_weights():
     tot = jax.ops.segment_sum(data * w[:, None], ids, num_segments=6)
     den = jax.ops.segment_sum(w[:, None], ids, num_segments=6)
     np.testing.assert_allclose(got, tot / jnp.maximum(den, 1e-6), rtol=1e-4, atol=1e-5)
+
+
+def test_zero_row_inputs_return_zeros():
+    out = pallas_segment.segment_sum(jnp.zeros((0, 4), jnp.float32),
+                                     jnp.zeros((0,), jnp.int32), 5, True)
+    assert out.shape == (5, 4) and float(jnp.sum(out)) == 0.0
+    g = pallas_segment.gather_rows(jnp.zeros((3, 4), jnp.float32),
+                                   jnp.zeros((0,), jnp.int32), True)
+    assert g.shape == (0, 4)
