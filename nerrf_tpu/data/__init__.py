@@ -6,6 +6,7 @@ from nerrf_tpu.data.loaders import (
 )
 from nerrf_tpu.data.synth import SimConfig, simulate_trace, make_corpus
 from nerrf_tpu.data.labels import derive_event_labels
+from nerrf_tpu.data.stream import StreamBatch, build_stream, build_streams, STREAM_FEATURE_DIM
 
 __all__ = [
     "GroundTruth",
@@ -16,4 +17,8 @@ __all__ = [
     "simulate_trace",
     "make_corpus",
     "derive_event_labels",
+    "StreamBatch",
+    "build_stream",
+    "build_streams",
+    "STREAM_FEATURE_DIM",
 ]

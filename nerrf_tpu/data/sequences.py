@@ -66,6 +66,28 @@ class SequenceBatch:
         )
 
 
+def event_features(ev, idx, feats_table, t0: int, t1: int) -> np.ndarray:
+    """Vectorized per-event features [len(idx), SEQ_FEATURE_DIM] — the one
+    source of the feature layout documented above.  Feature 7 (inter-event
+    gap) is context-dependent (per-file vs whole-stream) and left zero for
+    the caller to fill."""
+    ts = ev.ts_ns[idx]
+    f = np.zeros((len(idx), SEQ_FEATURE_DIM), np.float32)
+    sys = ev.syscall[idx]
+    slot = np.full(len(idx), 5, np.int64)
+    for sc, sl in _SYS_SLOT.items():
+        slot[sys == sc] = sl
+    f[np.arange(len(idx)), slot] = 1.0
+    f[:, 6] = np.log1p(ev.bytes[idx] / 1024.0)
+    pf = feats_table[ev.path_id[idx]]
+    nf = feats_table[ev.new_path_id[idx]]
+    f[:, 8] = np.maximum(pf[:, 4], nf[:, 4])
+    f[:, 9] = ((sys == int(Syscall.OPENAT)) & (ev.flags[idx] > 0)).astype(np.float32)
+    f[:, 10] = (ts - t0) / (t1 - t0)
+    f[:, 11] = pf[:, 5]
+    return f
+
+
 def build_file_sequences(
     trace: Trace,
     labels: np.ndarray | None = None,
@@ -98,22 +120,7 @@ def build_file_sequences(
 
     ts = ev.ts_ns[idx]
     t0, t1 = int(ts.min()), max(int(ts.max()), int(ts.min()) + 1)
-    feats_table = trace.strings.features()
-
-    # vectorized per-event features
-    f = np.zeros((len(idx), SEQ_FEATURE_DIM), np.float32)
-    sys = ev.syscall[idx]
-    slot = np.full(len(idx), 5, np.int64)
-    for sc, sl in _SYS_SLOT.items():
-        slot[sys == sc] = sl
-    f[np.arange(len(idx)), slot] = 1.0
-    f[:, 6] = np.log1p(ev.bytes[idx] / 1024.0)
-    pf = feats_table[ev.path_id[idx]]
-    nf = feats_table[ev.new_path_id[idx]]
-    f[:, 8] = np.maximum(pf[:, 4], nf[:, 4])
-    f[:, 9] = ((sys == int(Syscall.OPENAT)) & (ev.flags[idx] > 0)).astype(np.float32)
-    f[:, 10] = (ts - t0) / (t1 - t0)
-    f[:, 11] = pf[:, 5]
+    f = event_features(ev, idx, trace.strings.features(), t0, t1)
 
     inode = ev.inode[idx]
     uniq, inv = np.unique(inode, return_inverse=True)

@@ -1,6 +1,7 @@
 from nerrf_tpu.models.graphsage import GraphSAGET, GraphSAGEConfig
 from nerrf_tpu.models.lstm import ImpactLSTM, LSTMConfig
 from nerrf_tpu.models.joint import NerrfNet, JointConfig
+from nerrf_tpu.models.stream import StreamNet, StreamConfig, stream_loss
 
 __all__ = [
     "GraphSAGET",
@@ -9,4 +10,7 @@ __all__ = [
     "LSTMConfig",
     "NerrfNet",
     "JointConfig",
+    "StreamNet",
+    "StreamConfig",
+    "stream_loss",
 ]

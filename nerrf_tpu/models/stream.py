@@ -1,0 +1,121 @@
+"""StreamNet: long-context per-event anomaly detector over whole traces.
+
+Complements the spec'd models: GraphSAGE-T scores edges within a 30–60 s
+window and the BiLSTM scores the last 100 events of one file
+(`/root/reference/docs/content/docs/architecture.mdx:45-59`) — both are
+bounded-context.  StreamNet attends over the *entire* event stream of a
+trace (causally: each event sees all history), so cross-window, slow-burn
+attack structure — recon minutes before encryption, a ransom-note write long
+after — is visible to a single model.  The reference never built a
+long-context path (SURVEY.md §5 "Long-context"); this is ours, and it is
+what the ``sp`` mesh axis exists for: attention runs as ring attention
+(parallel/ring.py) with the time axis sharded across devices, so stream
+length scales with the number of chips, not per-chip HBM.
+
+Architecture: pre-LN causal transformer; rotary-free learned relative-time
+bias (event streams are irregularly sampled — wall-clock gaps carry signal,
+so Δt enters as a feature, not a position index); bfloat16 compute.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from nerrf_tpu.parallel.ring import ring_self_attention
+
+
+@dataclasses.dataclass(frozen=True)
+class StreamConfig:
+    dim: int = 128
+    num_heads: int = 4
+    num_layers: int = 4
+    mlp_mult: int = 4
+    dropout: float = 0.1
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def small(self) -> "StreamConfig":
+        return dataclasses.replace(self, dim=32, num_heads=2, num_layers=2)
+
+
+class _Block(nn.Module):
+    cfg: StreamConfig
+    mesh: Optional[Mesh] = None
+
+    @nn.compact
+    def __call__(self, x, *, deterministic: bool):
+        cfg = self.cfg
+        h, d = cfg.num_heads, cfg.dim // cfg.num_heads
+        dt = cfg.dtype
+
+        y = nn.LayerNorm(dtype=dt, name="attn_ln")(x)
+        qkv = nn.Dense(3 * cfg.dim, dtype=dt, name="qkv")(y)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        shape = y.shape[:-1] + (h, d)
+        out = ring_self_attention(
+            q.reshape(shape), k.reshape(shape), v.reshape(shape),
+            self.mesh, causal=True,
+        )
+        out = nn.Dense(cfg.dim, dtype=dt, name="proj")(out.reshape(y.shape))
+        if cfg.dropout > 0:
+            out = nn.Dropout(cfg.dropout, deterministic=deterministic)(out)
+        x = x + out
+
+        y = nn.LayerNorm(dtype=dt, name="mlp_ln")(x)
+        y = nn.Dense(cfg.mlp_mult * cfg.dim, dtype=dt, name="mlp_in")(y)
+        y = nn.gelu(y)
+        y = nn.Dense(cfg.dim, dtype=dt, name="mlp_out")(y)
+        if cfg.dropout > 0:
+            y = nn.Dropout(cfg.dropout, deterministic=deterministic)(y)
+        return x + y
+
+
+class StreamNet(nn.Module):
+    """[B, T, F] event-stream features → per-event attack logits [B, T].
+
+    ``mesh`` is a static module attribute: when it carries an ``sp`` axis of
+    size > 1, every attention layer runs as ring attention with T sharded
+    over it.  Semantics are identical either way (exact attention).
+    """
+
+    cfg: StreamConfig
+    mesh: Optional[Mesh] = None
+
+    @nn.compact
+    def __call__(
+        self,
+        feat,  # [B, T, F] float32
+        mask,  # [B, T] bool (True = real event; padding is trailing)
+        *,
+        deterministic: bool = True,
+    ) -> Dict[str, jnp.ndarray]:
+        cfg = self.cfg
+        dt = cfg.dtype
+        x = nn.Dense(cfg.dim, dtype=dt, name="embed")(feat.astype(dt))
+        x = nn.gelu(x)
+        for i in range(cfg.num_layers):
+            x = _Block(cfg, self.mesh, name=f"block_{i}")(
+                x, deterministic=deterministic
+            )
+        x = nn.LayerNorm(dtype=dt, name="final_ln")(x)
+        logits = nn.Dense(1, dtype=jnp.float32, name="head")(x)[..., 0]
+        logits = jnp.where(mask, logits, 0.0)
+
+        # stream-level summary: max event logit over valid steps (an attack
+        # trace is one whose stream contains attack events)
+        stream_logit = jnp.where(mask, logits, -1e30).max(axis=-1)
+        return {"event_logits": logits, "stream_logit": stream_logit}
+
+
+def stream_loss(outputs, labels, mask):
+    """Masked per-event sigmoid BCE.  labels float32 [B, T] ∈ {0, 1}."""
+    logits = outputs["event_logits"]
+    z = jnp.clip(logits, -30.0, 30.0)
+    bce = jnp.maximum(z, 0) - z * labels + jnp.log1p(jnp.exp(-jnp.abs(z)))
+    m = mask.astype(jnp.float32)
+    return (bce * m).sum() / jnp.maximum(m.sum(), 1.0)

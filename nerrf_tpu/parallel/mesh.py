@@ -11,9 +11,9 @@ Axes:
     array.  Gradient all-reduce rides ICI.
   * ``tp``  — tensor parallel: hidden dimensions of the larger weight
     matrices (GNN block kernels, LSTM projections, embeddings).
-  * ``sp``  — sequence parallel, reserved for the long-context stream
-    encoder (ring attention via shard_map+ppermute); no consumer is wired to
-    it yet, so leave sp=1 unless you are that consumer.
+  * ``sp``  — sequence parallel: StreamNet (models/stream.py) shards the
+    event-stream time axis over it and runs attention as a ring
+    (parallel/ring.py, shard_map + ppermute over ICI).
 
 Multi-host: `make_mesh` uses all visible devices (`jax.devices()`), which on a
 multi-host TPU pod spans hosts; each host feeds its local shard of the batch

@@ -1,0 +1,126 @@
+"""Ring attention: sequence-parallel exact attention over the ``sp`` mesh axis.
+
+The long-context path of the framework.  The reference has no sequence
+parallelism of any kind (SURVEY.md §2.3) — its longest sequence is the
+LSTM's 100-event window — but NERRF's real input is an unbounded syscall
+stream (the spec'd corpus is 100 h of traces, `ROADMAP.md:50`), and a
+whole-stream attention detector needs sequences far past one chip's HBM.
+
+Design: flash-style blockwise softmax accumulation + K/V rotation.  Each
+``sp`` shard holds one contiguous chunk of Q/K/V; at every step it computes
+its queries against the K/V block it currently holds, folds the result into
+an online-softmax accumulator (running max ``m``, denominator ``l``,
+numerator ``o``), then passes the block to its ring neighbor with
+`lax.ppermute` — XLA lowers the rotation onto ICI, overlapping it with the
+block matmuls.  After P steps every query has seen every key exactly once;
+memory stays O(chunk²) per device and the result is *exact* attention, not
+an approximation.  (Blockwise/ring formulation per the public Ring Attention
+literature; see PAPERS.md.)
+
+Causality is global: chunk offsets are derived from `lax.axis_index`, so the
+mask is identical to single-device causal attention.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+_NEG = -1e30
+
+
+def _attention_local(q, k, v, causal: bool) -> jnp.ndarray:
+    """Plain single-shard attention; the reference semantics ring attention
+    must reproduce.  q,k,v: [B, T, H, D] → [B, T, H, D]."""
+    scale = q.shape[-1] ** -0.5
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        tq, tk = q.shape[1], k.shape[1]
+        mask = jnp.tril(jnp.ones((tq, tk), bool))
+        scores = jnp.where(mask[None, None], scores, _NEG)
+    w = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", w, v)
+
+
+def _ring_shard(q, k, v, *, axis_name: str, manual_axes: tuple, causal: bool) -> jnp.ndarray:
+    """Per-shard body under shard_map.  q,k,v: [B, C, H, D] local chunks."""
+    p = jax.lax.psum(1, axis_name)
+    my = jax.lax.axis_index(axis_name)
+    b, c, h, d = q.shape
+    scale = d ** -0.5
+
+    q32 = q.astype(jnp.float32)
+    q_pos = my * c + jax.lax.broadcasted_iota(jnp.int32, (c, 1), 0)  # [C,1] global
+
+    # fresh zeros are axis-invariant; mark them varying over the manual axes
+    # so the fori_loop carry type matches its (varying) outputs
+    pv = lambda x: jax.lax.pcast(x, manual_axes, to="varying")
+    o0 = pv(jnp.zeros((b, c, h, d), jnp.float32))
+    m0 = pv(jnp.full((b, h, c), _NEG, jnp.float32))
+    l0 = pv(jnp.zeros((b, h, c), jnp.float32))
+    perm = [(j, (j + 1) % p) for j in range(p)]
+
+    def step(i, carry):
+        o, m, l, k_blk, v_blk = carry
+        src = (my - i) % p  # original owner of the block we hold now
+        scores = jnp.einsum(
+            "bqhd,bkhd->bhqk", q32, k_blk.astype(jnp.float32)
+        ) * scale
+        if causal:
+            k_pos = src * c + jax.lax.broadcasted_iota(jnp.int32, (1, c), 1)
+            scores = jnp.where((k_pos <= q_pos)[None, None], scores, _NEG)
+        m_new = jnp.maximum(m, scores.max(axis=-1))
+        pexp = jnp.exp(scores - m_new[..., None])
+        alpha = jnp.exp(m - m_new)
+        l = alpha * l + pexp.sum(axis=-1)
+        o = alpha.transpose(0, 2, 1)[..., None] * o + jnp.einsum(
+            "bhqk,bkhd->bqhd", pexp, v_blk.astype(jnp.float32)
+        )
+        k_blk, v_blk = jax.lax.ppermute((k_blk, v_blk), axis_name, perm)
+        return o, m_new, l, k_blk, v_blk
+
+    o, m, l, _, _ = jax.lax.fori_loop(0, p, step, (o0, m0, l0, k, v))
+    out = o / jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def ring_self_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    mesh: Optional[Mesh] = None,
+    *,
+    seq_axis: str = "sp",
+    batch_axis: str = "dp",
+    causal: bool = True,
+) -> jnp.ndarray:
+    """Exact attention over [B, T, H, D], sequence-sharded when sp > 1.
+
+    With no mesh (or sp == 1) this is ordinary attention; with sp > 1 the
+    T axis is chunked over the ``sp`` mesh axis and K/V blocks rotate over
+    ICI.  B stays sharded over ``dp`` (no communication on that axis).
+    """
+    if mesh is None or mesh.shape.get(seq_axis, 1) == 1:
+        out = _attention_local(
+            q.astype(jnp.float32), k.astype(jnp.float32), v.astype(jnp.float32),
+            causal,
+        )
+        return out.astype(q.dtype)
+
+    spec = P(batch_axis, seq_axis, None, None)
+    fn = jax.shard_map(
+        partial(
+            _ring_shard,
+            axis_name=seq_axis,
+            manual_axes=(batch_axis, seq_axis),
+            causal=causal,
+        ),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+    return fn(q, k, v)

@@ -78,3 +78,65 @@ def make_sharded_train_step(model: NerrfNet, cfg: TrainConfig, mesh: Mesh):
         return state, loss, aux, rng
 
     return train_step
+
+
+# --- long-context stream training (dp × sp) ----------------------------------
+
+
+def stream_shardings(mesh: Mesh) -> Dict[str, "jax.sharding.NamedSharding"]:
+    """Stream batches shard batch over dp and *time* over sp — the layout ring
+    attention expects (parallel/ring.py)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return {
+        "feat": NamedSharding(mesh, P("dp", "sp", None)),
+        "mask": NamedSharding(mesh, P("dp", "sp")),
+        "label": NamedSharding(mesh, P("dp", "sp")),
+    }
+
+
+def make_stream_train_step(model, mesh: Mesh, learning_rate: float = 1e-3):
+    """(init_fn, step_fn) for StreamNet over a dp×sp mesh.
+
+    ``model`` must be a StreamNet constructed with this mesh so its attention
+    layers run the sp ring.  Gradients all-reduce over dp×sp automatically
+    (GSPMD); the only hand-written collective in the whole step is the
+    ppermute inside ring attention.
+    """
+    import optax
+    from flax.training import train_state
+
+    from nerrf_tpu.models.stream import stream_loss
+
+    sh = stream_shardings(mesh)
+    tx = optax.adamw(learning_rate)
+
+    def place(batch):
+        return {k: jax.device_put(jnp.asarray(v), sh[k]) for k, v in batch.items()}
+
+    def init_fn(rng, placed_batch):
+        """``placed_batch`` must come from ``place`` — init reuses it, so the
+        host→device transfer happens once per batch, not once per caller."""
+        params = jax.jit(
+            lambda r: model.init(
+                r, placed_batch["feat"], placed_batch["mask"], deterministic=True
+            )["params"]
+        )(rng)
+        return train_state.TrainState.create(
+            apply_fn=model.apply, params=params, tx=tx
+        )
+
+    def loss_fn(params, batch, dropout_rng):
+        out = model.apply(
+            {"params": params}, batch["feat"], batch["mask"],
+            deterministic=False, rngs={"dropout": dropout_rng},
+        )
+        return stream_loss(out, batch["label"], batch["mask"])
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def step_fn(state, batch, rng):
+        rng, dropout_rng = jax.random.split(rng)
+        loss, grads = jax.value_and_grad(loss_fn)(state.params, batch, dropout_rng)
+        return state.apply_gradients(grads=grads), loss, rng
+
+    return init_fn, step_fn, place
