@@ -41,6 +41,18 @@ class StreamBatch:
     def arrays(self) -> dict[str, np.ndarray]:
         return {"feat": self.feat, "mask": self.mask, "label": self.label}
 
+    def tile_to_multiple(self, n: int) -> dict[str, np.ndarray]:
+        """Arrays with batch tiled (wrapping) up to the next multiple of n.
+
+        Always covers every segment at least once (rounds len up, never
+        down), so data-parallel sharding over ``n`` devices drops nothing.
+        """
+        if len(self) == 0:
+            raise ValueError("cannot tile an empty StreamBatch")
+        size = max(n, ((len(self) + n - 1) // n) * n)
+        idx = np.arange(size) % len(self)
+        return {k: v[idx] for k, v in self.arrays().items()}
+
 
 def build_stream(trace: Trace, max_len: int = 1024) -> StreamBatch:
     """Trace → [num_segments, max_len, F] padded stream segments."""

@@ -38,10 +38,6 @@ class StreamConfig:
     dropout: float = 0.1
     dtype: Any = jnp.bfloat16
 
-    @property
-    def small(self) -> "StreamConfig":
-        return dataclasses.replace(self, dim=32, num_heads=2, num_layers=2)
-
 
 class _Block(nn.Module):
     cfg: StreamConfig
@@ -114,8 +110,8 @@ class StreamNet(nn.Module):
 
 def stream_loss(outputs, labels, mask):
     """Masked per-event sigmoid BCE.  labels float32 [B, T] ∈ {0, 1}."""
-    logits = outputs["event_logits"]
-    z = jnp.clip(logits, -30.0, 30.0)
-    bce = jnp.maximum(z, 0) - z * labels + jnp.log1p(jnp.exp(-jnp.abs(z)))
-    m = mask.astype(jnp.float32)
-    return (bce * m).sum() / jnp.maximum(m.sum(), 1.0)
+    from nerrf_tpu.train.loop import _weighted_bce
+
+    return _weighted_bce(
+        outputs["event_logits"], labels, mask.astype(jnp.float32), 1.0
+    )

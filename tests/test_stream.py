@@ -49,8 +49,8 @@ def test_streamnet_sharded_forward_matches_unsharded(mesh):
     trace = simulate_trace(SimConfig(num_target_files=5, duration_sec=40.0, seed=3))
     sb = build_stream(trace, max_len=128)
     # batch must divide dp (2): tile segments to an even count
-    idx = np.arange(max(2, (len(sb) + 1) // 2 * 2)) % len(sb)
-    feat, mask = jnp.asarray(sb.feat[idx]), jnp.asarray(sb.mask[idx])
+    tiled = sb.tile_to_multiple(2)
+    feat, mask = jnp.asarray(tiled["feat"]), jnp.asarray(tiled["mask"])
 
     cfg = StreamConfig(dim=32, num_heads=2, num_layers=2, dropout=0.0)
     rng = jax.random.PRNGKey(0)
@@ -72,9 +72,7 @@ def test_stream_training_step_runs_and_improves(mesh):
         for s in (1, 2)
     ]
     sb = build_streams(traces, max_len=128)
-    n = max(2, (len(sb) // 2) * 2)
-    idx = np.arange(n) % len(sb)
-    batch = {"feat": sb.feat[idx], "mask": sb.mask[idx], "label": sb.label[idx]}
+    batch = sb.tile_to_multiple(2)
 
     cfg = StreamConfig(dim=32, num_heads=2, num_layers=2, dropout=0.0)
     model = StreamNet(cfg, mesh=mesh)
