@@ -1,0 +1,296 @@
+"""Experiment config layer: dataclass ⇄ JSON, plus the experiment registry.
+
+The reference has essentially no config system — one env var
+(`/root/reference/tracker/cmd/tracker/main.go:43-48,113`), Makefile vars, and
+constants hardcoded in the simulator/bash scripts
+(`sim_lockbit_m1.py:15-22`, `m1_minikube_bootstrap.sh:7-16`).  This module is
+the real config layer our build introduces: every experiment in
+BASELINE.json's ``configs`` list is a named, serializable `Experiment` whose
+JSON form is checked in under ``configs/`` and whose in-memory form is plain
+nested dataclasses (SimConfig / DatasetConfig / TrainConfig / MeshConfig /
+MCTSConfig / StreamConfig).
+
+Serialization rules (kept deliberately small):
+  * nested dataclasses recurse;
+  * ``dtype`` fields (jnp.bfloat16 & friends — type objects, not instances)
+    encode as the numpy dtype name and decode via ``jnp.<name>``;
+  * unknown keys on load are an error (config drift should fail loudly).
+
+CLI::
+
+    python -m nerrf_tpu.config list
+    python -m nerrf_tpu.config dump <name> [--out FILE]
+    python -m nerrf_tpu.config sync          # rewrite configs/*.json
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import typing
+from pathlib import Path
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from nerrf_tpu.data.synth import SimConfig
+from nerrf_tpu.graph.builder import GraphConfig
+from nerrf_tpu.models.graphsage import GraphSAGEConfig
+from nerrf_tpu.models.joint import JointConfig
+from nerrf_tpu.models.lstm import LSTMConfig
+from nerrf_tpu.models.stream import StreamConfig
+from nerrf_tpu.parallel.mesh import MeshConfig
+from nerrf_tpu.planner.mcts import MCTSConfig
+from nerrf_tpu.train.data import DatasetConfig
+from nerrf_tpu.train.loop import TrainConfig
+
+CONFIG_DIR = Path(__file__).resolve().parent.parent / "configs"
+
+
+# --------------------------------------------------------------------------
+# dataclass ⇄ dict
+# --------------------------------------------------------------------------
+
+def _is_dtype_like(v: Any) -> bool:
+    if v is None or isinstance(v, (bool, int, float, str)):
+        return False
+    try:
+        np.dtype(v)
+        return True
+    except TypeError:
+        return False
+
+
+def to_dict(cfg: Any) -> Any:
+    """Recursively convert a (nested) config dataclass to JSON-able data."""
+    if dataclasses.is_dataclass(cfg) and not isinstance(cfg, type):
+        return {
+            f.name: to_dict(getattr(cfg, f.name))
+            for f in dataclasses.fields(cfg)
+        }
+    if isinstance(cfg, (list, tuple)):
+        return [to_dict(v) for v in cfg]
+    if isinstance(cfg, dict):
+        return {k: to_dict(v) for k, v in cfg.items()}
+    if _is_dtype_like(cfg):
+        return np.dtype(cfg).name
+    return cfg
+
+
+def _unwrap_optional(tp: Any) -> Any:
+    if typing.get_origin(tp) is typing.Union:
+        args = [a for a in typing.get_args(tp) if a is not type(None)]
+        if len(args) == 1:
+            return args[0]
+    return tp
+
+
+def from_dict(cls: type, data: Dict[str, Any]) -> Any:
+    """Rebuild dataclass ``cls`` from `to_dict` output.  Unknown keys raise."""
+    if not dataclasses.is_dataclass(cls):
+        raise TypeError(f"{cls!r} is not a dataclass")
+    hints = typing.get_type_hints(cls)
+    fields = {f.name: f for f in dataclasses.fields(cls)}
+    unknown = set(data) - set(fields)
+    if unknown:
+        raise KeyError(f"unknown config keys for {cls.__name__}: {sorted(unknown)}")
+    kwargs: Dict[str, Any] = {}
+    for name, value in data.items():
+        tp = _unwrap_optional(hints.get(name, Any))
+        f = fields[name]
+        if value is None:
+            kwargs[name] = None
+        elif dataclasses.is_dataclass(tp) and isinstance(value, dict):
+            kwargs[name] = from_dict(tp, value)
+        elif name == "dtype" or (
+            isinstance(value, str)
+            and f.default is not dataclasses.MISSING
+            and _is_dtype_like(f.default)
+        ):
+            import jax.numpy as jnp
+
+            kwargs[name] = getattr(jnp, str(value))
+        else:
+            kwargs[name] = value
+    return cls(**kwargs)
+
+
+# --------------------------------------------------------------------------
+# Experiment
+# --------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class CorpusConfig:
+    """How many simulated traces to generate and at what scale."""
+
+    num_traces: int = 12
+    attack_fraction: float = 0.5
+    base_seed: int = 42
+    duration_sec: float = 300.0
+    num_target_files: int = 45
+    benign_rate_hz: float = 60.0
+    eval_fraction: float = 0.25
+
+
+@dataclasses.dataclass(frozen=True)
+class Experiment:
+    """One named, fully-specified run = BASELINE.json `configs` entry."""
+
+    name: str
+    description: str
+    corpus: CorpusConfig = CorpusConfig()
+    dataset: DatasetConfig = DatasetConfig()
+    train: TrainConfig = TrainConfig()
+    mesh: MeshConfig = MeshConfig()
+    mcts: MCTSConfig = MCTSConfig()
+    stream: Optional[StreamConfig] = None
+
+    def to_json(self, indent: int = 2) -> str:
+        return json.dumps(to_dict(self), indent=indent, sort_keys=False) + "\n"
+
+    @classmethod
+    def from_json(cls, text: str) -> "Experiment":
+        return from_dict(cls, json.loads(text))
+
+    def save(self, path: str | Path) -> Path:
+        path = Path(path)
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(self.to_json())
+        return path
+
+    @classmethod
+    def load(cls, path: str | Path) -> "Experiment":
+        return cls.from_json(Path(path).read_text())
+
+
+def _small_joint() -> JointConfig:
+    return JointConfig(
+        gnn=GraphSAGEConfig(hidden=64, num_layers=8),
+        lstm=LSTMConfig(hidden=64, num_layers=1),
+    )
+
+
+def _experiments() -> Dict[str, Experiment]:
+    """The five BASELINE.json configs, as runnable experiment specs."""
+    toy = Experiment(
+        name="toy-graphsage",
+        description=(
+            "GraphSAGE-T anomaly detector on datasets/traces/toy_trace.csv "
+            "(single short trace, CPU-sized model; BASELINE.json configs[0])"
+        ),
+        corpus=CorpusConfig(num_traces=4, duration_sec=120.0,
+                            num_target_files=12, benign_rate_hz=25.0,
+                            eval_fraction=0.5),
+        dataset=DatasetConfig(
+            graph=GraphConfig(window_sec=45.0, stride_sec=15.0,
+                              max_nodes=128, max_edges=256),
+            seq_len=50, max_seqs=64,
+        ),
+        train=TrainConfig(model=_small_joint(), batch_size=4, num_steps=200,
+                          eval_every=50, seq_loss_weight=0.0),
+    )
+    lstm = Experiment(
+        name="lstm-impact",
+        description=(
+            "BiLSTM impact predictor on per-file syscall event sequences "
+            "(reference spec architecture.mdx:55-59; BASELINE.json configs[1])"
+        ),
+        corpus=CorpusConfig(num_traces=8, duration_sec=240.0,
+                            num_target_files=24, benign_rate_hz=40.0),
+        dataset=DatasetConfig(seq_len=100, max_seqs=128),
+        train=TrainConfig(
+            model=JointConfig(gnn=GraphSAGEConfig(hidden=32, num_layers=2),
+                              lstm=LSTMConfig(), fuse=False),
+            batch_size=8, num_steps=400, edge_loss_weight=0.0,
+            node_loss_weight=0.0, seq_loss_weight=1.0,
+        ),
+    )
+    joint = Experiment(
+        name="joint-100h",
+        description=(
+            "Joint GraphSAGE-T + BiLSTM training at full flagship size on the "
+            "long labelled corpus (ROADMAP.md:62-69; BASELINE.json configs[2])"
+        ),
+        corpus=CorpusConfig(num_traces=24, duration_sec=600.0,
+                            num_target_files=45, benign_rate_hz=60.0),
+        dataset=DatasetConfig(seq_len=100, max_seqs=128),
+        train=TrainConfig(batch_size=8, num_steps=2000, eval_every=200),
+    )
+    mcts = Experiment(
+        name="mcts-lockbit",
+        description=(
+            "MCTS rollback planner with GNN value net on the LockBit-on-"
+            "WordPress scenario (architecture.mdx:62-72; BASELINE.json configs[3])"
+        ),
+        corpus=CorpusConfig(num_traces=6, duration_sec=300.0),
+        train=TrainConfig(model=_small_joint(), batch_size=8, num_steps=600),
+        mcts=MCTSConfig(num_simulations=800, batch_size=32),
+    )
+    multihost = Experiment(
+        name="multihost-online",
+        description=(
+            "Multi-host pod training + online planner (supply-chain image-"
+            "poison scenario; BASELINE.json configs[4]): dp×tp mesh for the "
+            "joint model, sp ring attention for the stream detector"
+        ),
+        corpus=CorpusConfig(num_traces=16, duration_sec=600.0),
+        train=TrainConfig(batch_size=16, num_steps=2000, eval_every=200),
+        mesh=MeshConfig(dp=-1, tp=2, sp=1),
+        mcts=MCTSConfig(num_simulations=1000, batch_size=64),
+        stream=StreamConfig(),
+    )
+    return {e.name: e for e in (toy, lstm, joint, mcts, multihost)}
+
+
+EXPERIMENTS: Dict[str, Experiment] = _experiments()
+
+
+def get_experiment(name_or_path: str) -> Experiment:
+    """Resolve a registry name, a ``configs/<name>.json``, or any JSON path."""
+    if name_or_path in EXPERIMENTS:
+        return EXPERIMENTS[name_or_path]
+    p = Path(name_or_path)
+    if p.exists():
+        return Experiment.load(p)
+    p = CONFIG_DIR / f"{name_or_path}.json"
+    if p.exists():
+        return Experiment.load(p)
+    raise KeyError(
+        f"unknown experiment {name_or_path!r}; registry: {sorted(EXPERIMENTS)}"
+    )
+
+
+def sync_config_dir(out_dir: str | Path = CONFIG_DIR) -> list[Path]:
+    """Write every registry experiment to ``configs/<name>.json``."""
+    return [e.save(Path(out_dir) / f"{name}.json") for name, e in EXPERIMENTS.items()]
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(prog="nerrf_tpu.config")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    sub.add_parser("list")
+    d = sub.add_parser("dump")
+    d.add_argument("name")
+    d.add_argument("--out")
+    sub.add_parser("sync")
+    args = ap.parse_args(argv)
+
+    if args.cmd == "list":
+        for name, e in EXPERIMENTS.items():
+            print(f"{name:18s} {e.description}")
+    elif args.cmd == "dump":
+        exp = get_experiment(args.name)
+        if args.out:
+            exp.save(args.out)
+        else:
+            print(exp.to_json(), end="")
+    elif args.cmd == "sync":
+        for p in sync_config_dir():
+            print(p)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

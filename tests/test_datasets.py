@@ -1,0 +1,112 @@
+"""On-disk dataset formats: CSV/Parquet round-trips, corpus dirs, toy trace."""
+
+import numpy as np
+import pytest
+
+from nerrf_tpu.data.datasets import (
+    export_corpus,
+    load_corpus,
+    load_trace_csv,
+    load_trace_parquet,
+    make_hour_corpus,
+    toy_trace,
+    write_ground_truth_csv,
+    write_trace_csv,
+    write_trace_parquet,
+)
+from nerrf_tpu.data.loaders import load_ground_truth_csv
+from nerrf_tpu.data.synth import SimConfig, simulate_trace
+
+
+def _small_trace(attack=True, seed=3):
+    return simulate_trace(
+        SimConfig(duration_sec=60.0, attack=attack, attack_start_sec=20.0,
+                  num_target_files=4, min_file_bytes=32 * 1024,
+                  max_file_bytes=64 * 1024, chunk_bytes=16 * 1024,
+                  benign_rate_hz=8.0, seed=seed),
+        name=f"t{seed}",
+    )
+
+
+def _assert_traces_equal(a, b):
+    assert a.events.num_valid == b.events.num_valid
+    va, vb = a.events.valid, b.events.valid
+    np.testing.assert_array_equal(a.events.ts_ns[va], b.events.ts_ns[vb])
+    np.testing.assert_array_equal(a.events.syscall[va], b.events.syscall[vb])
+    np.testing.assert_array_equal(a.events.bytes[va], b.events.bytes[vb])
+    np.testing.assert_allclose(a.labels[va], b.labels[vb])
+    # resolved strings survive the round-trip
+    for i in np.flatnonzero(va)[:50]:
+        assert a.strings.lookup(int(a.events.path_id[i])) == \
+            b.strings.lookup(int(b.events.path_id[i]))
+
+
+def test_csv_roundtrip(tmp_path):
+    t = _small_trace()
+    p = write_trace_csv(t, tmp_path / "t.csv")
+    _assert_traces_equal(t, load_trace_csv(p))
+
+
+def test_parquet_roundtrip(tmp_path):
+    t = _small_trace()
+    p = write_trace_parquet(t, tmp_path / "t.parquet")
+    _assert_traces_equal(t, load_trace_parquet(p))
+
+
+def test_ground_truth_roundtrip(tmp_path):
+    t = _small_trace()
+    p = write_ground_truth_csv(t.ground_truth, tmp_path / "gt.csv")
+    gt = load_ground_truth_csv(p)
+    # writer rounds to whole seconds (reference format)
+    assert abs(gt.start_ns - t.ground_truth.start_ns) < 1e9
+    assert gt.end_ns >= t.ground_truth.end_ns - 1  # ceil
+    assert gt.attack_family == t.ground_truth.attack_family
+    assert gt.target_path == t.ground_truth.target_path
+
+
+def test_corpus_roundtrip(tmp_path):
+    traces = [_small_trace(attack=True, seed=5), _small_trace(attack=False, seed=6)]
+    out = export_corpus(traces, tmp_path / "corpus")
+    back = load_corpus(out)
+    assert [t.name for t in back] == [t.name for t in traces]
+    assert back[0].ground_truth is not None
+    assert back[1].ground_truth is None
+    _assert_traces_equal(traces[0], back[0])
+
+
+def test_hour_corpus_scales():
+    traces = make_hour_corpus(hours=0.5, attack_hours=1.0 / 6.0,
+                              trace_minutes=10.0)
+    n_attack = sum(t.ground_truth is not None for t in traces)
+    assert len(traces) == 4 and n_attack == 1
+    assert all(t.events.num_valid > 0 for t in traces)
+
+
+def test_checked_in_toy_trace_matches_generator(repo_root):
+    """datasets/traces/toy_trace.csv is the deterministic toy_trace() output."""
+    p = repo_root / "datasets" / "traces" / "toy_trace.csv"
+    assert p.exists(), "run: python -m nerrf_tpu.data.datasets toy"
+    _assert_traces_equal(toy_trace(), load_trace_csv(p))
+    gt = load_ground_truth_csv(repo_root / "datasets" / "traces" /
+                               "toy_ground_truth.csv")
+    assert gt.attack_family == "LockBitSynthetic"
+
+
+def test_toy_trace_trains_to_signal(repo_root):
+    """BASELINE.json configs[0]: toy trace → windows → edge ROC-AUC ≥ 0.85."""
+    import dataclasses
+
+    from nerrf_tpu.config import get_experiment
+    from nerrf_tpu.train import build_dataset
+    from nerrf_tpu.train.loop import train_nerrfnet
+
+    exp = get_experiment("toy-graphsage")
+    t = load_trace_csv(repo_root / "datasets" / "traces" / "toy_trace.csv",
+                       ground_truth=load_ground_truth_csv(
+                           repo_root / "datasets" / "traces" / "toy_ground_truth.csv"))
+    ds = build_dataset([t], exp.dataset)
+    assert len(ds) >= 2
+    cfg = dataclasses.replace(exp.train, model=exp.train.model.small,
+                              num_steps=60, eval_every=30, batch_size=2)
+    res = train_nerrfnet(ds, eval_ds=ds, cfg=cfg)
+    assert res.metrics["edge_auc"] >= 0.85
