@@ -1,0 +1,91 @@
+/* C ABI of the native trace store (libnerrf_tracestore.so).
+ *
+ * The embedded time-bucketed event store the reference planned as "RocksDB
+ * with 30 s delta compaction" for its trace/graph persistence
+ * (`/root/reference/README.md:113`, `ROADMAP.md:58`) but never built.  This
+ * is the TPU-host equivalent: an append-only store whose unit of compaction
+ * is the graph constructor's time bucket, so a sliding-window query
+ * (`architecture.mdx:32-43`) touches only the overlapping segments.
+ *
+ * On-disk layout (shared byte-for-byte with the Python fallback in
+ * nerrf_tpu/graph/store.py):
+ *   <dir>/strings.log                append-only, per string:
+ *                                    u32 little-endian length + utf-8 bytes;
+ *                                    global id = order of appearance (0 = "").
+ *   <dir>/segments/<min>-<max>-<seq>.seg
+ *                                    "NRRFSEG1" magic, u64 record count,
+ *                                    then count fixed 72-byte records sorted
+ *                                    by ts_ns.  <min>/<max> are the bucket's
+ *                                    inclusive ts bounds, <seq> a
+ *                                    monotonically increasing generation so
+ *                                    a re-compacted bucket supersedes its
+ *                                    predecessor (highest seq wins).
+ *
+ * Record layout, little-endian, mirroring schema/events.py::_COLUMNS:
+ *   i64 ts_ns; i32 pid, tid, comm_id, syscall, path_id, new_path_id, flags;
+ *   i64 ret_val, bytes, inode; i32 mode, uid, gid;   (= 72 bytes)
+ * comm_id/path_id/new_path_id reference the *global* string pool.
+ *
+ * Appends accumulate in a memory delta; nerrf_store_flush() (or an append
+ * that crosses the auto-flush threshold) sorts the delta, splits it into
+ * bucket_ns-aligned buckets, merges each with any existing segment for the
+ * same bucket, and rewrites one segment per bucket — the delta compaction.
+ */
+#ifndef NERRF_TRACESTORE_H_
+#define NERRF_TRACESTORE_H_
+
+#include <stddef.h>
+#include <stdint.h>
+
+#include "nerrf/ingest.h" /* nerrf_columns_t */
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef struct nerrf_store nerrf_store_t;
+
+enum { NERRF_STORE_RECORD_SIZE = 72 };
+
+/* Open (creating if needed) a store rooted at `dir`.  bucket_ns <= 0 selects
+ * the default 30 s bucket.  Returns NULL on I/O error. */
+nerrf_store_t *nerrf_store_open(const char *dir, int64_t bucket_ns);
+void nerrf_store_close(nerrf_store_t *st);
+
+/* Append `n` rows.  String ids in cols refer to `strings` (the caller's
+ * table, `n_strings` entries); they are re-interned into the store's global
+ * pool.  Rows with cols->valid[i] == 0 are skipped.  Returns rows accepted,
+ * or -1 on error. */
+int64_t nerrf_store_append(nerrf_store_t *st, const nerrf_columns_t *cols,
+                           size_t n, const char *const *strings,
+                           size_t n_strings);
+
+/* Compact the in-memory delta into bucket segments.  Returns the number of
+ * segment files written (0 if the delta was empty), or -1 on error. */
+int64_t nerrf_store_flush(nerrf_store_t *st);
+
+/* Number of events with start_ns <= ts_ns < end_ns (delta + segments). */
+int64_t nerrf_store_query_count(nerrf_store_t *st, int64_t start_ns,
+                                int64_t end_ns);
+
+/* Fill `cols` (capacity `cap`) with the query result, sorted by ts_ns;
+ * string ids are global pool ids.  Returns rows written, or -1 if cap is
+ * too small / on error. */
+int64_t nerrf_store_query(nerrf_store_t *st, int64_t start_ns, int64_t end_ns,
+                          nerrf_columns_t *cols, size_t cap);
+
+/* Global string pool access (for rebuilding a caller-side table). */
+int64_t nerrf_store_num_strings(const nerrf_store_t *st);
+const char *nerrf_store_string(const nerrf_store_t *st, int64_t id);
+
+/* Observability.  total_rows = delta rows + the sum of segment record
+ * counts (an upper bound for any query's result size). */
+int64_t nerrf_store_num_segments(const nerrf_store_t *st);
+int64_t nerrf_store_delta_rows(const nerrf_store_t *st);
+int64_t nerrf_store_total_rows(const nerrf_store_t *st);
+
+#ifdef __cplusplus
+} /* extern "C" */
+#endif
+
+#endif /* NERRF_TRACESTORE_H_ */

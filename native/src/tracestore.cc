@@ -1,0 +1,397 @@
+/* Embedded time-bucketed trace store; see include/nerrf/tracestore.h for the
+ * format contract (shared with the Python fallback).  Single-writer,
+ * in-process — the durability model is "crash loses at most the un-flushed
+ * delta", matching the reference's planned 30 s delta compaction window
+ * (`/root/reference/README.md:113`). */
+
+#include "nerrf/tracestore.h"
+
+#include <algorithm>
+#include <cerrno>
+#include <cstdio>
+#include <cstring>
+#include <filesystem>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace fs = std::filesystem;
+
+namespace {
+
+constexpr int64_t kDefaultBucketNs = 30LL * 1000 * 1000 * 1000;
+constexpr size_t kAutoFlushRows = 1u << 18;  // bound delta memory + crash loss
+constexpr char kMagic[8] = {'N', 'R', 'R', 'F', 'S', 'E', 'G', '1'};
+
+#pragma pack(push, 1)
+struct Record {
+  int64_t ts_ns;
+  int32_t pid, tid, comm_id, syscall_id, path_id, new_path_id, flags;
+  int64_t ret_val, bytes, inode;
+  int32_t mode, uid, gid;
+};
+#pragma pack(pop)
+static_assert(sizeof(Record) == NERRF_STORE_RECORD_SIZE, "record layout");
+
+struct Segment {
+  int64_t min_ts = 0;  // inclusive
+  int64_t max_ts = 0;  // inclusive
+  int64_t seq = 0;
+  int64_t count = 0;
+  fs::path path;
+};
+
+bool ts_less(const Record &a, const Record &b) { return a.ts_ns < b.ts_ns; }
+
+}  // namespace
+
+struct nerrf_store {
+  fs::path dir;
+  int64_t bucket_ns = kDefaultBucketNs;
+  int64_t next_seq = 0;
+
+  std::vector<std::string> strings;               // global pool, [0] = ""
+  std::unordered_map<std::string, int32_t> index; // string -> global id
+  FILE *strings_log = nullptr;
+
+  std::vector<Record> delta;
+  std::vector<Segment> segments;  // live (highest-seq per bucket) only
+
+  ~nerrf_store() {
+    if (strings_log) fclose(strings_log);
+  }
+
+  int32_t intern(const std::string &s) {
+    auto it = index.find(s);
+    if (it != index.end()) return it->second;
+    int32_t id = static_cast<int32_t>(strings.size());
+    uint32_t len = static_cast<uint32_t>(s.size());
+    // log first, cache only on success: a failed write must not leave an id
+    // cached in memory that later appends would persist without a log entry
+    if (fwrite(&len, 4, 1, strings_log) != 1 ||
+        (len && fwrite(s.data(), 1, len, strings_log) != len))
+      return -1;
+    strings.push_back(s);
+    index.emplace(s, id);
+    return id;
+  }
+
+  bool load_strings() {
+    fs::path p = dir / "strings.log";
+    FILE *f = fopen(p.c_str(), "rb");
+    long good_bytes = 0;  // offset of the last fully-parsed record
+    if (f) {
+      uint32_t len;
+      std::string s;
+      while (fread(&len, 4, 1, f) == 1) {
+        s.resize(len);
+        if (len && fread(&s[0], 1, len, f) != len) break;  // truncated tail
+        good_bytes += 4 + static_cast<long>(len);
+        if (index.find(s) == index.end()) {
+          index.emplace(s, static_cast<int32_t>(strings.size()));
+          strings.push_back(s);
+        }
+      }
+      fclose(f);
+      // drop any torn tail so appended records parse from a clean boundary
+      std::error_code ec;
+      if (good_bytes < static_cast<long>(fs::file_size(p, ec)) && !ec)
+        fs::resize_file(p, good_bytes, ec);
+    }
+    if (strings.empty()) {
+      strings.push_back("");
+      index.emplace("", 0);
+    }
+    strings_log = fopen(p.c_str(), "ab");
+    if (!strings_log) return false;
+    if (ftell(strings_log) == 0) {
+      // fresh log: persist the implicit "" so replays see identical ids
+      uint32_t zero = 0;
+      if (fwrite(&zero, 4, 1, strings_log) != 1) return false;
+      for (size_t i = 1; i < strings.size(); ++i) {
+        uint32_t len = static_cast<uint32_t>(strings[i].size());
+        if (fwrite(&len, 4, 1, strings_log) != 1 ||
+            fwrite(strings[i].data(), 1, len, strings_log) != len)
+          return false;
+      }
+    }
+    return true;
+  }
+
+  bool scan_segments() {
+    fs::path segdir = dir / "segments";
+    std::error_code ec;
+    fs::create_directories(segdir, ec);
+    if (ec) return false;
+    // bucket start -> best segment
+    std::unordered_map<int64_t, Segment> best;
+    std::vector<fs::path> stale;
+    for (const auto &ent : fs::directory_iterator(segdir)) {
+      if (ent.path().extension() != ".seg") continue;
+      Segment s;
+      s.path = ent.path();
+      long long mn, mx, seq;
+      if (sscanf(ent.path().filename().c_str(), "%lld-%lld-%lld.seg", &mn, &mx,
+                 &seq) != 3)
+        continue;
+      s.min_ts = mn;
+      s.max_ts = mx;
+      s.seq = seq;
+      FILE *f = fopen(s.path.c_str(), "rb");
+      if (!f) return false;
+      char magic[8];
+      uint64_t count = 0;
+      bool ok = fread(magic, 8, 1, f) == 1 &&
+                memcmp(magic, kMagic, 8) == 0 && fread(&count, 8, 1, f) == 1;
+      fclose(f);
+      if (!ok) continue;  // corrupt segment: ignore
+      s.count = static_cast<int64_t>(count);
+      next_seq = std::max(next_seq, s.seq + 1);
+      int64_t bucket = s.min_ts;
+      auto it = best.find(bucket);
+      if (it == best.end()) {
+        best.emplace(bucket, s);
+      } else if (s.seq > it->second.seq) {
+        stale.push_back(it->second.path);
+        it->second = s;
+      } else {
+        stale.push_back(s.path);
+      }
+    }
+    for (const auto &p : stale) fs::remove(p, ec);
+    for (auto &kv : best) segments.push_back(kv.second);
+    std::sort(segments.begin(), segments.end(),
+              [](const Segment &a, const Segment &b) {
+                return a.min_ts < b.min_ts;
+              });
+    return true;
+  }
+
+  bool read_segment(const Segment &s, std::vector<Record> *out) const {
+    FILE *f = fopen(s.path.c_str(), "rb");
+    if (!f) return false;
+    char magic[8];
+    uint64_t count = 0;
+    bool ok = fread(magic, 8, 1, f) == 1 && memcmp(magic, kMagic, 8) == 0 &&
+              fread(&count, 8, 1, f) == 1;
+    if (ok) {
+      size_t base = out->size();
+      out->resize(base + count);
+      ok = fread(out->data() + base, sizeof(Record), count, f) == count;
+      if (!ok) out->resize(base);
+    }
+    fclose(f);
+    return ok;
+  }
+
+  bool write_segment(int64_t bucket_start, const std::vector<Record> &recs) {
+    int64_t min_ts = bucket_start;
+    int64_t max_ts = bucket_start + bucket_ns - 1;
+    int64_t seq = next_seq++;
+    char name[96];
+    snprintf(name, sizeof(name), "%lld-%lld-%lld.seg",
+             static_cast<long long>(min_ts), static_cast<long long>(max_ts),
+             static_cast<long long>(seq));
+    fs::path final_path = dir / "segments" / name;
+    fs::path tmp_path = final_path;
+    tmp_path += ".tmp";
+    FILE *f = fopen(tmp_path.c_str(), "wb");
+    if (!f) return false;
+    uint64_t count = recs.size();
+    bool ok = fwrite(kMagic, 8, 1, f) == 1 && fwrite(&count, 8, 1, f) == 1 &&
+              fwrite(recs.data(), sizeof(Record), count, f) == count;
+    ok = (fclose(f) == 0) && ok;
+    if (!ok) return false;
+    std::error_code ec;
+    fs::rename(tmp_path, final_path, ec);
+    if (ec) return false;
+
+    // supersede any previous segment for this bucket
+    for (auto it = segments.begin(); it != segments.end(); ++it) {
+      if (it->min_ts == min_ts) {
+        fs::remove(it->path, ec);
+        segments.erase(it);
+        break;
+      }
+    }
+    Segment s;
+    s.min_ts = min_ts;
+    s.max_ts = max_ts;
+    s.seq = seq;
+    s.count = static_cast<int64_t>(count);
+    s.path = final_path;
+    segments.insert(std::upper_bound(segments.begin(), segments.end(), s,
+                                     [](const Segment &a, const Segment &b) {
+                                       return a.min_ts < b.min_ts;
+                                     }),
+                    s);
+    return true;
+  }
+
+  int64_t flush() {
+    if (delta.empty()) return 0;
+    fflush(strings_log);
+    std::stable_sort(delta.begin(), delta.end(), ts_less);
+    int64_t written = 0;
+    size_t i = 0;
+    while (i < delta.size()) {
+      int64_t bucket = delta[i].ts_ns - (((delta[i].ts_ns % bucket_ns) +
+                                          bucket_ns) % bucket_ns);
+      std::vector<Record> recs;
+      // existing segment for this bucket merges with the new delta slice
+      for (const auto &s : segments)
+        if (s.min_ts == bucket && !read_segment(s, &recs)) return -1;
+      size_t j = i;
+      while (j < delta.size() && delta[j].ts_ns < bucket + bucket_ns) ++j;
+      recs.insert(recs.end(), delta.begin() + i, delta.begin() + j);
+      std::stable_sort(recs.begin(), recs.end(), ts_less);
+      if (!write_segment(bucket, recs)) return -1;
+      ++written;
+      i = j;
+    }
+    delta.clear();
+    return written;
+  }
+
+  void collect(int64_t start_ns, int64_t end_ns,
+               std::vector<Record> *out) const {
+    for (const auto &s : segments) {
+      if (s.max_ts < start_ns || s.min_ts >= end_ns) continue;
+      std::vector<Record> recs;
+      if (!read_segment(s, &recs)) continue;
+      for (const auto &r : recs)
+        if (r.ts_ns >= start_ns && r.ts_ns < end_ns) out->push_back(r);
+    }
+    for (const auto &r : delta)
+      if (r.ts_ns >= start_ns && r.ts_ns < end_ns) out->push_back(r);
+    std::stable_sort(out->begin(), out->end(), ts_less);
+  }
+};
+
+extern "C" {
+
+nerrf_store_t *nerrf_store_open(const char *dir, int64_t bucket_ns) {
+  auto *st = new (std::nothrow) nerrf_store();
+  if (!st) return nullptr;
+  st->dir = dir;
+  st->bucket_ns = bucket_ns > 0 ? bucket_ns : kDefaultBucketNs;
+  std::error_code ec;
+  fs::create_directories(st->dir, ec);
+  if (ec || !st->load_strings() || !st->scan_segments()) {
+    delete st;
+    return nullptr;
+  }
+  return st;
+}
+
+void nerrf_store_close(nerrf_store_t *st) {
+  if (!st) return;
+  st->flush();
+  delete st;
+}
+
+int64_t nerrf_store_append(nerrf_store_t *st, const nerrf_columns_t *cols,
+                           size_t n, const char *const *strings,
+                           size_t n_strings) {
+  if (!st || !cols) return -1;
+  // caller id -> global id, resolved once per append
+  std::vector<int32_t> remap(n_strings, 0);
+  for (size_t i = 0; i < n_strings; ++i) {
+    int32_t id = st->intern(strings[i] ? strings[i] : "");
+    if (id < 0) return -1;
+    remap[i] = id;
+  }
+  auto mapped = [&](int32_t id) -> int32_t {
+    return (id >= 0 && static_cast<size_t>(id) < n_strings) ? remap[id] : 0;
+  };
+  int64_t accepted = 0;
+  for (size_t i = 0; i < n; ++i) {
+    if (cols->valid && !cols->valid[i]) continue;
+    Record r;
+    r.ts_ns = cols->ts_ns[i];
+    r.pid = cols->pid[i];
+    r.tid = cols->tid[i];
+    r.comm_id = mapped(cols->comm_id[i]);
+    r.syscall_id = cols->syscall_id[i];
+    r.path_id = mapped(cols->path_id[i]);
+    r.new_path_id = mapped(cols->new_path_id[i]);
+    r.flags = cols->flags[i];
+    r.ret_val = cols->ret_val[i];
+    r.bytes = cols->bytes[i];
+    r.inode = cols->inode[i];
+    r.mode = cols->mode[i];
+    r.uid = cols->uid[i];
+    r.gid = cols->gid[i];
+    st->delta.push_back(r);
+    ++accepted;
+  }
+  if (st->delta.size() >= kAutoFlushRows && st->flush() < 0) return -1;
+  return accepted;
+}
+
+int64_t nerrf_store_flush(nerrf_store_t *st) {
+  if (!st) return -1;
+  return st->flush();
+}
+
+int64_t nerrf_store_query_count(nerrf_store_t *st, int64_t start_ns,
+                                int64_t end_ns) {
+  if (!st) return -1;
+  std::vector<Record> out;
+  st->collect(start_ns, end_ns, &out);
+  return static_cast<int64_t>(out.size());
+}
+
+int64_t nerrf_store_query(nerrf_store_t *st, int64_t start_ns, int64_t end_ns,
+                          nerrf_columns_t *cols, size_t cap) {
+  if (!st || !cols) return -1;
+  std::vector<Record> out;
+  st->collect(start_ns, end_ns, &out);
+  if (out.size() > cap) return -1;
+  for (size_t i = 0; i < out.size(); ++i) {
+    const Record &r = out[i];
+    cols->ts_ns[i] = r.ts_ns;
+    cols->pid[i] = r.pid;
+    cols->tid[i] = r.tid;
+    cols->comm_id[i] = r.comm_id;
+    cols->syscall_id[i] = r.syscall_id;
+    cols->path_id[i] = r.path_id;
+    cols->new_path_id[i] = r.new_path_id;
+    cols->flags[i] = r.flags;
+    cols->ret_val[i] = r.ret_val;
+    cols->bytes[i] = r.bytes;
+    cols->inode[i] = r.inode;
+    cols->mode[i] = r.mode;
+    cols->uid[i] = r.uid;
+    cols->gid[i] = r.gid;
+    if (cols->valid) cols->valid[i] = 1;
+  }
+  return static_cast<int64_t>(out.size());
+}
+
+int64_t nerrf_store_num_strings(const nerrf_store_t *st) {
+  return st ? static_cast<int64_t>(st->strings.size()) : -1;
+}
+
+const char *nerrf_store_string(const nerrf_store_t *st, int64_t id) {
+  if (!st || id < 0 || static_cast<size_t>(id) >= st->strings.size())
+    return nullptr;
+  return st->strings[id].c_str();
+}
+
+int64_t nerrf_store_num_segments(const nerrf_store_t *st) {
+  return st ? static_cast<int64_t>(st->segments.size()) : -1;
+}
+
+int64_t nerrf_store_delta_rows(const nerrf_store_t *st) {
+  return st ? static_cast<int64_t>(st->delta.size()) : -1;
+}
+
+int64_t nerrf_store_total_rows(const nerrf_store_t *st) {
+  if (!st) return -1;
+  int64_t total = static_cast<int64_t>(st->delta.size());
+  for (const auto &s : st->segments) total += s.count;
+  return total;
+}
+
+}  // extern "C"

@@ -162,6 +162,24 @@ class Experiment:
     def load(cls, path: str | Path) -> "Experiment":
         return cls.from_json(Path(path).read_text())
 
+    def build_corpus(self):
+        """Generate this experiment's corpus → (train_traces, eval_traces)."""
+        from nerrf_tpu.data.synth import make_corpus
+
+        c = self.corpus
+        traces = make_corpus(
+            c.num_traces, attack_fraction=c.attack_fraction,
+            base_seed=c.base_seed, duration_sec=c.duration_sec,
+            num_target_files=c.num_target_files,
+            benign_rate_hz=c.benign_rate_hz,
+        )
+        n_eval = (
+            min(len(traces) - 1, max(1, round(len(traces) * c.eval_fraction)))
+            if c.eval_fraction > 0 else 0
+        )
+        split = len(traces) - n_eval
+        return traces[:split], traces[split:]
+
 
 def _small_joint() -> JointConfig:
     return JointConfig(
@@ -179,7 +197,7 @@ def _experiments() -> Dict[str, Experiment]:
             "(single short trace, CPU-sized model; BASELINE.json configs[0])"
         ),
         corpus=CorpusConfig(num_traces=4, duration_sec=120.0,
-                            num_target_files=12, benign_rate_hz=25.0,
+                            num_target_files=8, benign_rate_hz=6.0,
                             eval_fraction=0.5),
         dataset=DatasetConfig(
             graph=GraphConfig(window_sec=45.0, stride_sec=15.0,

@@ -33,7 +33,7 @@ import numpy as np
 
 from nerrf_tpu.data.loaders import GroundTruth, Trace
 from nerrf_tpu.data.synth import SimConfig, simulate_trace
-from nerrf_tpu.schema.events import EventArrays, StringTable, Syscall
+from nerrf_tpu.schema.events import EventArrays, StringTable
 
 TRACE_COLUMNS = (
     "ts_ns", "pid", "tid", "comm", "syscall", "path", "new_path",
@@ -55,23 +55,9 @@ def trace_rows(trace: Trace):
     for i in range(len(ev)):
         if not ev.valid[i]:
             continue
-        yield {
-            "ts_ns": int(ev.ts_ns[i]),
-            "pid": int(ev.pid[i]),
-            "tid": int(ev.tid[i]),
-            "comm": st.lookup(int(ev.comm_id[i])),
-            "syscall": Syscall(int(ev.syscall[i])).name.lower(),
-            "path": st.lookup(int(ev.path_id[i])),
-            "new_path": st.lookup(int(ev.new_path_id[i])),
-            "flags": int(ev.flags[i]),
-            "ret_val": int(ev.ret_val[i]),
-            "bytes": int(ev.bytes[i]),
-            "inode": int(ev.inode[i]),
-            "mode": int(ev.mode[i]),
-            "uid": int(ev.uid[i]),
-            "gid": int(ev.gid[i]),
-            "label": float(labels[i]) if labels is not None else 0.0,
-        }
+        row = ev.record(i, st)
+        row["label"] = float(labels[i]) if labels is not None else 0.0
+        yield row
 
 
 def write_trace_csv(trace: Trace, path: str | Path) -> Path:
@@ -181,6 +167,7 @@ def export_corpus(traces: List[Trace], out_dir: str | Path,
         <out>/manifest.json
     """
     out = Path(out_dir)
+    out.mkdir(parents=True, exist_ok=True)
     manifest = {"format": "nerrf-corpus-v1", "traces": []}
     for t in traces:
         if parquet:
@@ -241,28 +228,19 @@ def toy_trace() -> Trace:
 def make_hour_corpus(hours: float, attack_hours: float = 1.0,
                      base_seed: int = 42, trace_minutes: float = 10.0):
     """The ROADMAP.md:50 corpus shape: ~`hours` benign + `attack_hours`
-    labelled attack, as independent `trace_minutes`-long runs."""
+    labelled attack, as independent `trace_minutes`-long runs.  Delegates to
+    `make_corpus`, whose Bresenham spread keeps both classes present in any
+    contiguous train/eval split."""
+    from nerrf_tpu.data.synth import make_corpus
+
     per = trace_minutes * 60.0
     n_attack = max(1, round(attack_hours * 3600.0 / per))
     n_benign = max(1, round(hours * 3600.0 / per))
-    traces = []
-    for i in range(n_benign + n_attack):
-        attack = i >= n_benign
-        rng = np.random.default_rng(base_seed + i)
-        traces.append(simulate_trace(
-            SimConfig(
-                duration_sec=per,
-                attack=attack,
-                attack_start_sec=per * float(rng.uniform(0.2, 0.6)),
-                num_target_files=int(rng.integers(20, 46)),
-                min_file_bytes=64 * 1024, max_file_bytes=256 * 1024,
-                chunk_bytes=32 * 1024,
-                benign_rate_hz=float(rng.uniform(30.0, 80.0)),
-                seed=base_seed + i,
-            ),
-            name=f"{'attack' if attack else 'benign'}-{i:04d}",
-        ))
-    return traces
+    n = n_benign + n_attack
+    return make_corpus(
+        n, attack_fraction=n_attack / n, base_seed=base_seed,
+        duration_sec=per, num_target_files=30, benign_rate_hz=55.0,
+    )
 
 
 def main(argv: Optional[List[str]] = None) -> int:

@@ -8,8 +8,11 @@ from nerrf_tpu.graph.builder import (
     NODE_FEATURE_DIM,
     EDGE_FEATURE_DIM,
 )
+from nerrf_tpu.graph.store import TraceStore, store_native_available
 
 __all__ = [
+    "TraceStore",
+    "store_native_available",
     "GraphConfig",
     "GraphBatch",
     "WindowStats",

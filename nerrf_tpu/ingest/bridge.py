@@ -67,18 +67,27 @@ class _Columns(ctypes.Structure):
     ]
 
 
-def _load_library(build: bool = True) -> Optional[ctypes.CDLL]:
-    if not os.path.exists(_LIB_PATH) and build:
-        try:  # best-effort build; the Python fallback covers failure
+def load_native_lib(lib_filename: str, build: bool = True) -> Optional[ctypes.CDLL]:
+    """Load native/build/<lib_filename>, building it on demand (best-effort;
+    callers fall back to their Python engines on None)."""
+    lib_path = os.path.abspath(os.path.join(_NATIVE_DIR, "build", lib_filename))
+    if not os.path.exists(lib_path) and build:
+        try:
             subprocess.run(
-                ["make", "-s", "build/libnerrf_ingest.so"],
+                ["make", "-s", f"build/{lib_filename}"],
                 cwd=_NATIVE_DIR, capture_output=True, timeout=120, check=False,
             )
         except (OSError, subprocess.TimeoutExpired):
             pass
-    if not os.path.exists(_LIB_PATH):
+    if not os.path.exists(lib_path):
         return None
-    lib = ctypes.CDLL(_LIB_PATH)
+    return ctypes.CDLL(lib_path)
+
+
+def _load_library(build: bool = True) -> Optional[ctypes.CDLL]:
+    lib = load_native_lib("libnerrf_ingest.so", build)
+    if lib is None:
+        return None
     lib.nerrf_ingest_new.restype = ctypes.c_void_p
     lib.nerrf_ingest_free.argtypes = [ctypes.c_void_p]
     lib.nerrf_decode_ring.restype = ctypes.c_int64

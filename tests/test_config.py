@@ -60,6 +60,16 @@ def test_checked_in_configs_match_registry():
         assert Experiment.load(path) == exp, f"{path} is stale"
 
 
+def test_build_corpus_uses_corpus_config():
+    exp = get_experiment("toy-graphsage")
+    train, evals = exp.build_corpus()
+    assert len(train) + len(evals) == exp.corpus.num_traces
+    assert len(evals) == round(exp.corpus.num_traces * exp.corpus.eval_fraction)
+    # both classes present in the train split (Bresenham spread)
+    assert any(t.ground_truth is not None for t in train)
+    assert any(t.ground_truth is None for t in train)
+
+
 def test_get_experiment_by_name_and_path(tmp_path):
     exp = get_experiment("toy-graphsage")
     assert exp.name == "toy-graphsage"

@@ -92,6 +92,7 @@ def test_checked_in_toy_trace_matches_generator(repo_root):
     assert gt.attack_family == "LockBitSynthetic"
 
 
+@pytest.mark.slow
 def test_toy_trace_trains_to_signal(repo_root):
     """BASELINE.json configs[0]: toy trace → windows → edge ROC-AUC ≥ 0.85."""
     import dataclasses
