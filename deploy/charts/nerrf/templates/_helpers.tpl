@@ -1,0 +1,10 @@
+{{- define "nerrf.labels" -}}
+app.kubernetes.io/name: {{ .Chart.Name }}
+app.kubernetes.io/instance: {{ .Release.Name }}
+app.kubernetes.io/version: {{ .Chart.AppVersion }}
+app.kubernetes.io/managed-by: {{ .Release.Service }}
+{{- end }}
+
+{{- define "nerrf.image" -}}
+{{ .Values.image.repository }}:{{ .Values.image.tag }}
+{{- end }}

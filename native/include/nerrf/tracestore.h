@@ -9,6 +9,10 @@
  *
  * On-disk layout (shared byte-for-byte with the Python fallback in
  * nerrf_tpu/graph/store.py):
+ *   <dir>/BUCKET                     decimal bucket_ns + newline, written at
+ *                                    creation; on open a stored value wins
+ *                                    over the caller's bucket_ns (bucket math
+ *                                    must match the segments on disk).
  *   <dir>/strings.log                append-only, per string:
  *                                    u32 little-endian length + utf-8 bytes;
  *                                    global id = order of appearance (0 = "").
@@ -69,8 +73,9 @@ int64_t nerrf_store_query_count(nerrf_store_t *st, int64_t start_ns,
                                 int64_t end_ns);
 
 /* Fill `cols` (capacity `cap`) with the query result, sorted by ts_ns;
- * string ids are global pool ids.  Returns rows written, or -1 if cap is
- * too small / on error. */
+ * string ids are global pool ids.  Returns rows written; -1 on invalid
+ * arguments; -(needed)-1 when `cap` is too small, where `needed` is the
+ * result size — retry with that capacity. */
 int64_t nerrf_store_query(nerrf_store_t *st, int64_t start_ns, int64_t end_ns,
                           nerrf_columns_t *cols, size_t cap);
 

@@ -175,6 +175,14 @@ struct nerrf_store {
     bool ok = fread(magic, 8, 1, f) == 1 && memcmp(magic, kMagic, 8) == 0 &&
               fread(&count, 8, 1, f) == 1;
     if (ok) {
+      // bound by the actual file size: a corrupt count must not drive a
+      // giant resize (bad_alloc would unwind across the C ABI and abort)
+      std::error_code ec;
+      uint64_t max_records =
+          (fs::file_size(s.path, ec) - 16) / sizeof(Record);
+      if (ec || count > max_records) ok = false;
+    }
+    if (ok) {
       size_t base = out->size();
       out->resize(base + count);
       ok = fread(out->data() + base, sizeof(Record), count, f) == count;
@@ -277,7 +285,30 @@ nerrf_store_t *nerrf_store_open(const char *dir, int64_t bucket_ns) {
   st->bucket_ns = bucket_ns > 0 ? bucket_ns : kDefaultBucketNs;
   std::error_code ec;
   fs::create_directories(st->dir, ec);
-  if (ec || !st->load_strings() || !st->scan_segments()) {
+  if (ec) {
+    delete st;
+    return nullptr;
+  }
+  // The bucket size is a property of the segments already on disk: a stored
+  // BUCKET file wins over the caller's request (mismatched bucket math would
+  // silently skip segments during queries).
+  fs::path bpath = st->dir / "BUCKET";
+  FILE *bf = fopen(bpath.c_str(), "rb");
+  if (bf) {
+    long long stored = 0;
+    if (fscanf(bf, "%lld", &stored) == 1 && stored > 0)
+      st->bucket_ns = stored;
+    fclose(bf);
+  } else {
+    bf = fopen(bpath.c_str(), "wb");
+    if (!bf) {
+      delete st;
+      return nullptr;
+    }
+    fprintf(bf, "%lld\n", static_cast<long long>(st->bucket_ns));
+    fclose(bf);
+  }
+  if (!st->load_strings() || !st->scan_segments()) {
     delete st;
     return nullptr;
   }
@@ -347,7 +378,8 @@ int64_t nerrf_store_query(nerrf_store_t *st, int64_t start_ns, int64_t end_ns,
   if (!st || !cols) return -1;
   std::vector<Record> out;
   st->collect(start_ns, end_ns, &out);
-  if (out.size() > cap) return -1;
+  if (out.size() > cap)  // tell the caller the size it needs: -(needed)-1
+    return -static_cast<int64_t>(out.size()) - 1;
   for (size_t i = 0; i < out.size(); ++i) {
     const Record &r = out[i];
     cols->ts_ns[i] = r.ts_ns;

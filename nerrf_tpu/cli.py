@@ -183,6 +183,76 @@ def cmd_status(args) -> int:
     return 0
 
 
+def _load_any_trace(path: str, ground_truth=None):
+    from nerrf_tpu.data.datasets import load_trace_csv, load_trace_parquet
+    from nerrf_tpu.data.loaders import load_trace_jsonl
+
+    p = Path(path)
+    if p.suffix == ".csv":
+        return load_trace_csv(p, ground_truth=ground_truth)
+    if p.suffix == ".parquet":
+        return load_trace_parquet(p, ground_truth=ground_truth)
+    return load_trace_jsonl(p, ground_truth=ground_truth)
+
+
+def cmd_serve(args) -> int:
+    """Serve a trace over the Tracker wire protocol (+ /metrics endpoint):
+    the replay flavor of the reference's tracker daemon, deployable as the
+    tracker container in the K8s manifests."""
+    import signal
+
+    from nerrf_tpu.ingest.service import TraceReplayServer
+    from nerrf_tpu.observability import MetricsServer
+
+    trace = _load_any_trace(args.trace)
+    host, _, port = args.address.rpartition(":")
+    server = TraceReplayServer(trace.events, trace.strings,
+                               address=f"{host or '0.0.0.0'}:{port}",
+                               batch_size=args.batch_size)
+    bound = server.start()
+    metrics = MetricsServer(host="0.0.0.0", port=args.metrics_port) \
+        if args.metrics_port >= 0 else None
+    _log(f"serving {trace.events.num_valid} events on :{bound}"
+         + (f", metrics on :{metrics.port}" if metrics else ""))
+    try:
+        if args.duration > 0:
+            time.sleep(args.duration)
+        else:
+            # sigwait only wakes for *blocked* signals; without the mask,
+            # SIGTERM takes its default disposition (hard kill, no cleanup)
+            signal.pthread_sigmask(
+                signal.SIG_BLOCK, {signal.SIGINT, signal.SIGTERM})
+            signal.sigwait({signal.SIGINT, signal.SIGTERM})
+    finally:
+        server.stop()
+        if metrics:
+            metrics.close()
+    return 0
+
+
+def cmd_ingest(args) -> int:
+    """Drain a tracker's StreamEvents into a trace store (the AI-side ingest
+    pod: gRPC → native decode → time-bucketed segments)."""
+    from nerrf_tpu.graph.store import TraceStore
+    from nerrf_tpu.ingest.service import TrackerClient
+
+    client = TrackerClient(args.target)
+    events, strings = client.stream(
+        max_events=args.max_events or None, timeout=args.timeout)
+    with TraceStore(args.store_dir, bucket_sec=args.bucket_sec) as st:
+        n = st.append(events, strings)
+        segments = st.flush()
+        out = {
+            "events": n,
+            "segments_written": segments,
+            "segments_live": st.num_segments,
+            "strings": st.num_strings,
+            "engine": "native" if st.is_native else "python",
+        }
+    print(json.dumps(out))
+    return 0
+
+
 # --------------------------------------------------------------------------
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="nerrf", description=__doc__)
@@ -215,6 +285,25 @@ def main(argv=None) -> int:
     p = sub.add_parser("status", help="incident state")
     p.add_argument("--incident", required=True)
     p.set_defaults(fn=cmd_status)
+
+    p = sub.add_parser("serve", help="serve a trace over the Tracker protocol")
+    p.add_argument("--trace", required=True,
+                   help="trace file (.jsonl/.csv/.parquet)")
+    p.add_argument("--address", default="0.0.0.0:50051")
+    p.add_argument("--metrics-port", type=int, default=9090,
+                   help="Prometheus /metrics port (-1 disables)")
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--duration", type=float, default=0,
+                   help="serve for N seconds then exit (0 = until signal)")
+    p.set_defaults(fn=cmd_serve)
+
+    p = sub.add_parser("ingest", help="drain a tracker into a trace store")
+    p.add_argument("--target", required=True, help="tracker host:port")
+    p.add_argument("--store-dir", required=True)
+    p.add_argument("--bucket-sec", type=float, default=30.0)
+    p.add_argument("--max-events", type=int, default=0)
+    p.add_argument("--timeout", type=float, default=30.0)
+    p.set_defaults(fn=cmd_ingest)
 
     args = ap.parse_args(argv)
     return args.fn(args)

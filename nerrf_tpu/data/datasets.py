@@ -239,7 +239,8 @@ def make_hour_corpus(hours: float, attack_hours: float = 1.0,
     n = n_benign + n_attack
     return make_corpus(
         n, attack_fraction=n_attack / n, base_seed=base_seed,
-        duration_sec=per, num_target_files=30, benign_rate_hz=55.0,
+        duration_sec=per, num_target_files=(20, 46),
+        benign_rate_hz=(30.0, 80.0),
     )
 
 

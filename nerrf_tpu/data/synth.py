@@ -276,24 +276,37 @@ def make_corpus(
     attack_fraction: float = 0.5,
     base_seed: int = 0,
     duration_sec: float = 240.0,
-    num_target_files: int = 12,
-    benign_rate_hz: float = 40.0,
+    num_target_files: int | tuple[int, int] = 12,
+    benign_rate_hz: float | tuple[float, float] = 40.0,
 ) -> List[Trace]:
-    """A corpus of independent runs (the ROADMAP.md:50 corpus, scaled by args)."""
+    """A corpus of independent runs (the ROADMAP.md:50 corpus, scaled by args).
+
+    `num_target_files` / `benign_rate_hz` may be (lo, hi) ranges, drawn per
+    trace, so corpus traces vary structurally and not just by sim seed.
+    """
     out = []
     for i in range(n_traces):
         # Bresenham-spread attack traces through the corpus so any contiguous
         # train/eval split keeps both classes
         attack = round((i + 1) * attack_fraction) - round(i * attack_fraction) == 1
+        rng = np.random.default_rng(base_seed + i)
+        files = (
+            int(rng.integers(num_target_files[0], num_target_files[1]))
+            if isinstance(num_target_files, tuple) else num_target_files
+        )
+        rate = (
+            float(rng.uniform(benign_rate_hz[0], benign_rate_hz[1]))
+            if isinstance(benign_rate_hz, tuple) else benign_rate_hz
+        )
         cfg = SimConfig(
             duration_sec=duration_sec,
             attack=attack,
-            attack_start_sec=duration_sec * float(np.random.default_rng(base_seed + i).uniform(0.2, 0.6)),
-            num_target_files=num_target_files,
+            attack_start_sec=duration_sec * float(rng.uniform(0.2, 0.6)),
+            num_target_files=files,
             min_file_bytes=64 * 1024,
             max_file_bytes=256 * 1024,
             chunk_bytes=32 * 1024,
-            benign_rate_hz=benign_rate_hz,
+            benign_rate_hz=rate,
             seed=base_seed + i,
         )
         out.append(simulate_trace(cfg, name=f"corpus-{i}-{'atk' if attack else 'benign'}"))

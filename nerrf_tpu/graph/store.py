@@ -124,6 +124,15 @@ class TraceStore:
                  use_native: Optional[bool] = None) -> None:
         self.root = Path(root)
         self.bucket_ns = int(bucket_sec * 1e9)
+        # a stored BUCKET wins: bucket math must match the on-disk segments
+        bpath = self.root / "BUCKET"
+        if bpath.exists():
+            stored = int(bpath.read_text().strip())
+            if stored > 0:
+                self.bucket_ns = stored
+        else:
+            self.root.mkdir(parents=True, exist_ok=True)
+            bpath.write_text(f"{self.bucket_ns}\n")
         if use_native is None:
             use_native = store_native_available()
         elif use_native and not store_native_available():
@@ -181,8 +190,18 @@ class TraceStore:
             got = _LIB.nerrf_store_flush(self._handle)
             if got < 0:
                 raise OSError("nerrf_store_flush failed")
-            return int(got)
-        return self._py.flush()
+            got = int(got)
+        else:
+            got = self._py.flush()
+        from nerrf_tpu.observability import DEFAULT_REGISTRY
+
+        DEFAULT_REGISTRY.counter_inc(
+            "store_compactions_total", got,
+            help="bucket segments written by delta compaction")
+        DEFAULT_REGISTRY.gauge_set(
+            "store_segments", self.num_segments,
+            help="live segment files in the trace store")
+        return got
 
     # --- reads --------------------------------------------------------------
 
@@ -195,15 +214,20 @@ class TraceStore:
         """Events in [start_ns, end_ns) sorted by time, with a StringTable
         whose ids match the returned columns (identity view of the pool)."""
         if self._native:
-            # size from the total-row upper bound: one collect pass, no
-            # count-then-fill double read of the overlapping segments
-            cap = int(_LIB.nerrf_store_total_rows(self._handle))
-            arrs, cols = _alloc_columns(cap)
-            got = _LIB.nerrf_store_query(
-                self._handle, start_ns, end_ns, ctypes.byref(cols), cap
-            )
-            if got < 0:
-                raise OSError("nerrf_store_query failed")
+            # start with a window-sized guess; on -(needed)-1 retry with the
+            # exact size.  Bounded by total rows so allocation never exceeds
+            # the store; typical window queries never retry more than once.
+            cap = min(int(_LIB.nerrf_store_total_rows(self._handle)), 1 << 16)
+            while True:
+                arrs, cols = _alloc_columns(cap)
+                got = _LIB.nerrf_store_query(
+                    self._handle, start_ns, end_ns, ctypes.byref(cols), cap
+                )
+                if got >= 0:
+                    break
+                if got == -1:
+                    raise OSError("nerrf_store_query failed")
+                cap = -int(got) - 1  # needed size reported by the store
             n = int(got)
             arrs = {k: v[:n] for k, v in arrs.items()}
             events = EventArrays(
@@ -301,13 +325,12 @@ class _PyStore:
                 mn, mx, seq = (int(x) for x in p.stem.split("-"))
             except ValueError:
                 continue
-            del mx
             self.next_seq = max(self.next_seq, seq + 1)
             cur = self.segments.get(mn)
             if cur is None or seq > cur[0]:
                 if cur is not None:
                     stale.append(cur[1])
-                self.segments[mn] = (seq, p)
+                self.segments[mn] = (seq, p, mx)
             else:
                 stale.append(p)
         for p in stale:
@@ -379,7 +402,7 @@ class _PyStore:
         old = self.segments.get(bucket)
         if old is not None:
             old[1].unlink(missing_ok=True)
-        self.segments[bucket] = (seq, final)
+        self.segments[bucket] = (seq, final, bucket + self.bucket_ns - 1)
 
     def flush(self) -> int:
         if not self.delta:
@@ -402,8 +425,9 @@ class _PyStore:
 
     def _collect(self, start_ns: int, end_ns: int) -> np.ndarray:
         parts = []
-        for bucket, (_, path) in self.segments.items():
-            if bucket + self.bucket_ns <= start_ns or bucket >= end_ns:
+        for bucket, (_, path, max_ts) in self.segments.items():
+            # skip by the segment's own stored bounds, not current bucket_ns
+            if max_ts < start_ns or bucket >= end_ns:
                 continue
             rec = self._read_segment(path)
             parts.append(rec[(rec["ts_ns"] >= start_ns) & (rec["ts_ns"] < end_ns)])

@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Iterator, Optional
 
 import grpc
@@ -70,7 +71,14 @@ class TraceReplayServer:
         # (The bounded drop-on-full queue policy applies to *live* capture
         # sources, where a producer thread feeds subscriber queues and a slow
         # consumer must not stall the ring-buffer drain; see subscriber_queue.)
-        yield from self._frames
+        from nerrf_tpu.observability import DEFAULT_REGISTRY
+
+        DEFAULT_REGISTRY.counter_inc(
+            "tracker_subscribers_total", help="StreamEvents subscriptions served")
+        for frame in self._frames:
+            DEFAULT_REGISTRY.counter_inc(
+                "tracker_frames_sent_total", help="EventBatch frames streamed")
+            yield frame
 
     def subscriber_queue(self) -> "queue.Queue[Optional[bytes]]":
         """Bounded frame queue with the live-source overflow policy: callers
@@ -123,8 +131,17 @@ class TrackerClient:
                 request_serializer=lambda m: m.SerializeToString(),
                 response_deserializer=lambda b: b,  # raw frame → native decode
             )(trace_pb2.Empty(), timeout=timeout)
+            from nerrf_tpu.observability import DEFAULT_REGISTRY
+
             for frame in call:
+                t0 = time.perf_counter()
                 block = self._bridge.decode_batch(frame)
+                DEFAULT_REGISTRY.histogram_observe(
+                    "ingest_decode_seconds", time.perf_counter() - t0,
+                    help="EventBatch frame decode latency")
+                DEFAULT_REGISTRY.counter_inc(
+                    "ingest_events_total", block.num_valid,
+                    help="events decoded from the tracker stream")
                 blocks.append(block)
                 total += block.num_valid
                 if max_events is not None and total >= max_events:

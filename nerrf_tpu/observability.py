@@ -1,0 +1,206 @@
+"""Host-side observability: metrics registry, Prometheus endpoint, profiling.
+
+The reference's observability story is Go stdlib logging plus a *promise* of
+Prometheus metrics in M2 (`/root/reference/docs/content/docs/tracker/overview.mdx:268`,
+`ROADMAP.md:59` "Prometheus metrics") that was never built.  This module is
+the real thing for our host plane:
+
+  * `MetricsRegistry` — thread-safe counters/gauges/histograms with labels,
+    rendered in the Prometheus text exposition format;
+  * `MetricsServer` — stdlib HTTP server (daemon thread) exposing
+    ``/metrics`` and ``/healthz`` — no external dependencies, suitable for a
+    scrape sidecar on the ingest bridge pod;
+  * `trace_profile` — context manager around the JAX profiler so any train
+    or inference loop can emit an XLA trace for TensorBoard/Perfetto (the
+    TPU analogue of the reference's promised bpftool introspection,
+    `implementation.mdx:569-589`).
+
+Device-side step metrics (loss, ROC-AUC, steps/s) stay in
+`nerrf_tpu.train.metrics`; this module is where they get *exported*.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import http.server
+import threading
+import time
+from typing import Dict, Iterable, Optional, Tuple
+
+_LabelKey = Tuple[Tuple[str, str], ...]
+
+
+def _labelkey(labels: Optional[Dict[str, str]]) -> _LabelKey:
+    return tuple(sorted((labels or {}).items()))
+
+
+def _fmt_labels(key: _LabelKey) -> str:
+    if not key:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in key)
+    return "{" + inner + "}"
+
+
+class MetricsRegistry:
+    """Counters, gauges, and fixed-bucket histograms with label sets."""
+
+    def __init__(self, namespace: str = "nerrf") -> None:
+        self.namespace = namespace
+        self._lock = threading.Lock()
+        self._counters: Dict[str, Dict[_LabelKey, float]] = {}
+        self._gauges: Dict[str, Dict[_LabelKey, float]] = {}
+        self._hists: Dict[str, Dict[_LabelKey, list]] = {}
+        self._hist_buckets: Dict[str, Tuple[float, ...]] = {}
+        self._help: Dict[str, str] = {}
+
+    def _name(self, name: str) -> str:
+        return f"{self.namespace}_{name}" if self.namespace else name
+
+    def counter_inc(self, name: str, value: float = 1.0,
+                    labels: Optional[Dict[str, str]] = None,
+                    help: str = "") -> None:
+        with self._lock:
+            d = self._counters.setdefault(name, {})
+            k = _labelkey(labels)
+            d[k] = d.get(k, 0.0) + value
+            if help:
+                self._help.setdefault(name, help)
+
+    def gauge_set(self, name: str, value: float,
+                  labels: Optional[Dict[str, str]] = None,
+                  help: str = "") -> None:
+        with self._lock:
+            self._gauges.setdefault(name, {})[_labelkey(labels)] = value
+            if help:
+                self._help.setdefault(name, help)
+
+    def histogram_observe(self, name: str, value: float,
+                          buckets: Iterable[float] = (
+                              0.001, 0.005, 0.025, 0.1, 0.5, 2.5, 10.0),
+                          labels: Optional[Dict[str, str]] = None,
+                          help: str = "") -> None:
+        with self._lock:
+            bk = self._hist_buckets.setdefault(name, tuple(buckets))
+            d = self._hists.setdefault(name, {})
+            k = _labelkey(labels)
+            if k not in d:
+                d[k] = [0] * (len(bk) + 1) + [0.0, 0]  # cumcounts, sum, count
+            cell = d[k]
+            for i, b in enumerate(bk):
+                if value <= b:
+                    cell[i] += 1
+            cell[len(bk)] += 1      # +Inf bucket
+            cell[-2] += value       # sum
+            cell[-1] += 1           # count
+            if help:
+                self._help.setdefault(name, help)
+
+    def value(self, name: str, labels: Optional[Dict[str, str]] = None) -> float:
+        with self._lock:
+            for table in (self._counters, self._gauges):
+                if name in table:
+                    return table[name].get(_labelkey(labels), 0.0)
+        return 0.0
+
+    def render(self) -> str:
+        """Prometheus text exposition format, one block per metric."""
+        out = []
+        with self._lock:
+            for name, series in sorted(self._counters.items()):
+                full = self._name(name)
+                if name in self._help:
+                    out.append(f"# HELP {full} {self._help[name]}")
+                out.append(f"# TYPE {full} counter")
+                for k, v in sorted(series.items()):
+                    out.append(f"{full}{_fmt_labels(k)} {v:g}")
+            for name, series in sorted(self._gauges.items()):
+                full = self._name(name)
+                if name in self._help:
+                    out.append(f"# HELP {full} {self._help[name]}")
+                out.append(f"# TYPE {full} gauge")
+                for k, v in sorted(series.items()):
+                    out.append(f"{full}{_fmt_labels(k)} {v:g}")
+            for name, series in sorted(self._hists.items()):
+                full = self._name(name)
+                bk = self._hist_buckets[name]
+                if name in self._help:
+                    out.append(f"# HELP {full} {self._help[name]}")
+                out.append(f"# TYPE {full} histogram")
+                for k, cell in sorted(series.items()):
+                    for i, b in enumerate(bk):
+                        lk = _labelkey(dict(dict(k), le=f"{b:g}"))
+                        out.append(f"{full}_bucket{_fmt_labels(lk)} {cell[i]}")
+                    lk = _labelkey(dict(dict(k), le="+Inf"))
+                    out.append(f"{full}_bucket{_fmt_labels(lk)} {cell[len(bk)]}")
+                    out.append(f"{full}_sum{_fmt_labels(k)} {cell[-2]:g}")
+                    out.append(f"{full}_count{_fmt_labels(k)} {cell[-1]}")
+        return "\n".join(out) + "\n"
+
+
+# The default registry the pipeline components report into.
+DEFAULT_REGISTRY = MetricsRegistry()
+
+
+class MetricsServer:
+    """Serves /metrics (text exposition) and /healthz from a daemon thread."""
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None,
+                 host: str = "127.0.0.1", port: int = 0) -> None:
+        registry = registry or DEFAULT_REGISTRY
+        start_ts = time.time()
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self) -> None:  # noqa: N802 (stdlib API)
+                if self.path.startswith("/metrics"):
+                    body = registry.render().encode()
+                    ctype = "text/plain; version=0.0.4; charset=utf-8"
+                elif self.path.startswith("/healthz"):
+                    body = (
+                        '{"status":"ok","uptime_sec":%.1f}\n'
+                        % (time.time() - start_ts)
+                    ).encode()
+                    ctype = "application/json"
+                else:
+                    self.send_error(404)
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args) -> None:  # silence per-request spam
+                del args
+
+        self._httpd = http.server.ThreadingHTTPServer((host, port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="nerrf-metrics", daemon=True
+        )
+        self._thread.start()
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5)
+
+    def __enter__(self) -> "MetricsServer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+@contextlib.contextmanager
+def trace_profile(log_dir: str, enabled: bool = True):
+    """JAX profiler trace around a region (TensorBoard/Perfetto readable)."""
+    if not enabled:
+        yield
+        return
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

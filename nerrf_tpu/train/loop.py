@@ -205,6 +205,12 @@ def train_nerrfnet(
             t_start = time.perf_counter()
         if step % cfg.eval_every == 0 or step == cfg.num_steps - 1:
             history.append({"step": step, "loss": float(loss)})
+            from nerrf_tpu.observability import DEFAULT_REGISTRY
+
+            DEFAULT_REGISTRY.gauge_set("train_step", step,
+                                       help="last completed train step")
+            DEFAULT_REGISTRY.gauge_set("train_loss", float(loss),
+                                       help="joint loss at last logged step")
             if log:
                 log(f"step {step}: loss={float(loss):.4f} "
                     + " ".join(f"{k}={float(v):.4f}" for k, v in aux.items()))

@@ -1,0 +1,67 @@
+#!/usr/bin/env bash
+# Cluster-free end-to-end test of the streaming pipeline.
+#
+# The runnable counterpart of the reference's minikube E2E
+# (`/root/reference/tracker/scripts/test.sh` — broken as shipped: hardcoded
+# /home/agasta paths, missing manifests): serve the toy trace over the real
+# Tracker gRPC protocol, drain it through the native ingest bridge into the
+# trace store, and pass iff at least EVENT_THRESHOLD ransomware-relevant
+# events (.dat/.lockbit paths — same jq filter semantics as test.sh:76-82)
+# arrive end-to-end.
+set -euo pipefail
+
+EVENT_THRESHOLD="${EVENT_THRESHOLD:-10}"
+PORT="${PORT:-50199}"
+WORK="$(mktemp -d)"
+trap '[ -n "${SERVER_PID:-}" ] && kill "$SERVER_PID" 2>/dev/null || true; rm -rf "$WORK"' EXIT
+
+cd "$(dirname "$0")/.."
+
+python -m nerrf_tpu.cli serve \
+    --trace datasets/traces/toy_trace.csv \
+    --address "127.0.0.1:${PORT}" --metrics-port -1 --duration 60 &
+SERVER_PID=$!
+
+for _ in $(seq 1 20); do
+    if python - "$PORT" <<'EOF' 2>/dev/null
+import socket, sys
+s = socket.create_connection(("127.0.0.1", int(sys.argv[1])), timeout=0.5)
+s.close()
+EOF
+    then break; fi
+    sleep 0.5
+done
+
+python -m nerrf_tpu.cli ingest \
+    --target "127.0.0.1:${PORT}" --store-dir "$WORK/store" \
+    --timeout 30 > "$WORK/ingest.json"
+cat "$WORK/ingest.json"
+
+python - "$WORK" "$EVENT_THRESHOLD" <<'EOF'
+import json, sys
+from pathlib import Path
+
+sys.path.insert(0, ".")
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+from nerrf_tpu.graph.store import TraceStore
+
+work, threshold = Path(sys.argv[1]), int(sys.argv[2])
+summary = json.loads((work / "ingest.json").read_text())
+with TraceStore(work / "store") as st:
+    ev, strings = st.query(0, 2**62)
+    hits = 0
+    for i in range(len(ev)):
+        if not ev.valid[i]:
+            continue
+        path = strings.lookup(int(ev.path_id[i]))
+        new = strings.lookup(int(ev.new_path_id[i]))
+        if any(x in p for p in (path, new) for x in (".dat", ".lockbit")):
+            hits += 1
+print(f"e2e: {summary['events']} events ingested, {hits} ransomware-relevant "
+      f"(threshold {threshold})")
+if summary["events"] == 0 or hits < threshold:
+    sys.exit(1)
+print("E2E PASS")
+EOF

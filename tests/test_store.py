@@ -125,6 +125,26 @@ def test_cross_engine_format(tmp_path, trace):
 
 
 @pytest.mark.parametrize("engine", ENGINES)
+def test_bucket_size_persists_across_reopen(tmp_path, trace, engine):
+    """Reopening with a different bucket_sec must not skip on-disk segments:
+    the stored BUCKET wins."""
+    lo = int(trace.events.ts_ns.min())
+    hi = int(trace.events.ts_ns.max()) + 1
+    with _open(tmp_path, engine, bucket_sec=60.0) as st:
+        st.append(trace.events, trace.strings)
+        st.flush()
+        n = st.query_count(lo, hi)
+    with _open(tmp_path, engine, bucket_sec=30.0) as st:  # mismatched request
+        assert st.bucket_ns == 60 * 10**9
+        assert st.query_count(lo, hi) == n
+        # mid-window query crossing the would-be-30s boundary
+        assert st.query_count(lo + 30 * 10**9, lo + 60 * 10**9) == int(
+            ((trace.events.ts_ns >= lo + 30 * 10**9)
+             & (trace.events.ts_ns < lo + 60 * 10**9)
+             & trace.events.valid).sum())
+
+
+@pytest.mark.parametrize("engine", ENGINES)
 def test_torn_strings_log_tail_recovers(tmp_path, trace, engine):
     """A crash-torn strings.log tail is truncated on reopen; earlier ids and
     later appends stay consistent."""
