@@ -1,0 +1,41 @@
+/* C ABI of the native Firecracker driver (libnerrf_fcdriver.so).
+ *
+ * The reference plans a Firecracker microVM undo sandbox in Rust
+ * (`/root/reference/README.md:101`; workflow at
+ * `docs/content/docs/architecture.mdx:75-87`) that was never built.  Rust is
+ * unavailable in this toolchain, so this is the C++ equivalent: a minimal
+ * HTTP/1.1 client over Firecracker's Unix-domain-socket API, enough to
+ * configure a microVM (boot source, drives), start it, pause it, and take
+ * snapshots — the primitives the clone→replay→verify gate needs on a KVM
+ * host.  Transport and framing live here; the sandbox *policy* (what to
+ * configure, when to approve) stays in Python (nerrf_tpu/rollback/).
+ *
+ * Every call is synchronous and connection-per-request (Firecracker's API
+ * socket expects short-lived requests).  Responses are returned as
+ * "HTTP/1.1 <status> ..." status line + parsed body.
+ */
+#ifndef NERRF_FCDRIVER_H_
+#define NERRF_FCDRIVER_H_
+
+#include <stddef.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+/* Perform one HTTP request over the Unix socket at `socket_path`.
+ * `method` is "GET"/"PUT"/"PATCH", `path` the API path (e.g. "/machine-config"),
+ * `body` a JSON payload or NULL.  On success writes the response body
+ * (NUL-terminated, truncated to `resp_cap-1`) into `resp` and returns the
+ * HTTP status code (e.g. 204).  Returns -1 on socket/connect error, -2 on
+ * send error, -3 on malformed response, -4 on timeout. */
+int nerrf_fc_request(const char *socket_path, const char *method,
+                     const char *path, const char *body, char *resp,
+                     size_t resp_cap, int timeout_ms);
+
+#ifdef __cplusplus
+} /* extern "C" */
+#endif
+
+#endif /* NERRF_FCDRIVER_H_ */

@@ -1,0 +1,147 @@
+/* Minimal HTTP/1.1-over-unix-socket client for the Firecracker API; see
+ * include/nerrf/fcdriver.h for the contract. */
+
+#include "nerrf/fcdriver.h"
+
+#include <sys/socket.h>
+#include <sys/time.h>
+#include <sys/un.h>
+#include <unistd.h>
+
+#include <cctype>
+#include <cerrno>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+
+namespace {
+
+int connect_unix(const char *socket_path, int timeout_ms) {
+  int fd = socket(AF_UNIX, SOCK_STREAM | SOCK_CLOEXEC, 0);
+  if (fd < 0) return -1;
+  sockaddr_un addr{};
+  addr.sun_family = AF_UNIX;
+  if (strlen(socket_path) >= sizeof(addr.sun_path)) {
+    close(fd);
+    return -1;
+  }
+  strncpy(addr.sun_path, socket_path, sizeof(addr.sun_path) - 1);
+  if (connect(fd, reinterpret_cast<sockaddr *>(&addr), sizeof(addr)) != 0) {
+    close(fd);
+    return -1;
+  }
+  timeval tv{timeout_ms / 1000, (timeout_ms % 1000) * 1000};
+  setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+  setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+  return fd;
+}
+
+bool send_all(int fd, const char *buf, size_t len) {
+  size_t off = 0;
+  while (off < len) {
+    ssize_t n = send(fd, buf + off, len - off, MSG_NOSIGNAL);
+    if (n <= 0) return false;
+    off += static_cast<size_t>(n);
+  }
+  return true;
+}
+
+}  // namespace
+
+extern "C" int nerrf_fc_request(const char *socket_path, const char *method,
+                                const char *path, const char *body, char *resp,
+                                size_t resp_cap, int timeout_ms) {
+  if (!socket_path || !method || !path) return -1;
+  if (timeout_ms <= 0) timeout_ms = 5000;
+  int fd = connect_unix(socket_path, timeout_ms);
+  if (fd < 0) return -1;
+
+  std::string req;
+  size_t body_len = body ? strlen(body) : 0;
+  req.reserve(256 + body_len);
+  req += method;
+  req += ' ';
+  req += path;
+  req += " HTTP/1.1\r\nHost: localhost\r\nAccept: application/json\r\n";
+  if (body_len) {
+    char clen[96];
+    snprintf(clen, sizeof(clen),
+             "Content-Type: application/json\r\nContent-Length: %zu\r\n",
+             body_len);
+    req += clen;
+  }
+  req += "Connection: close\r\n\r\n";
+  if (body_len) req += body;
+
+  if (!send_all(fd, req.data(), req.size())) {
+    close(fd);
+    return -2;
+  }
+
+  // Read until the response is *complete* — by Content-Length when the
+  // server sends one (Firecracker keeps connections alive, so waiting for
+  // EOF would stall until the recv timeout) — falling back to EOF framing.
+  std::string raw;
+  char buf[4096];
+  long content_length = -1;
+  size_t hdr_end_pos = std::string::npos;
+  for (;;) {
+    ssize_t n = recv(fd, buf, sizeof(buf), 0);
+    if (n < 0) {
+      close(fd);
+      return (errno == EAGAIN || errno == EWOULDBLOCK) ? -4 : -3;
+    }
+    if (n == 0) break;
+    raw.append(buf, static_cast<size_t>(n));
+    if (hdr_end_pos == std::string::npos) {
+      hdr_end_pos = raw.find("\r\n\r\n");
+      if (hdr_end_pos != std::string::npos) {
+        std::string hdr = raw.substr(0, hdr_end_pos);
+        for (auto &c : hdr) c = static_cast<char>(tolower(c));
+        size_t cl = hdr.find("content-length:");
+        if (cl != std::string::npos)
+          content_length = strtol(hdr.c_str() + cl + 15, nullptr, 10);
+        else if (hdr.find("transfer-encoding: chunked") == std::string::npos)
+          content_length = 0;  // no body advertised (e.g. 204)
+      }
+    }
+    if (hdr_end_pos != std::string::npos && content_length >= 0 &&
+        raw.size() - (hdr_end_pos + 4) >=
+            static_cast<size_t>(content_length))
+      break;
+    if (raw.size() > (1u << 20)) break;  // cap: the FC API never sends >1 MB
+  }
+  close(fd);
+
+  int status = 0;
+  if (sscanf(raw.c_str(), "HTTP/%*d.%*d %d", &status) != 1) return -3;
+  size_t hdr_end = raw.find("\r\n\r\n");
+  if (hdr_end == std::string::npos) return -3;
+  std::string payload = raw.substr(hdr_end + 4);
+
+  // Minimal chunked-transfer handling: FC itself uses Content-Length, but a
+  // fake test server may chunk; detect and strip the framing.
+  std::string headers = raw.substr(0, hdr_end);
+  for (auto &c : headers) c = static_cast<char>(tolower(c));
+  if (headers.find("transfer-encoding: chunked") != std::string::npos) {
+    std::string joined;
+    size_t pos = 0;
+    while (pos < payload.size()) {
+      size_t eol = payload.find("\r\n", pos);
+      if (eol == std::string::npos) break;
+      long chunk = strtol(payload.substr(pos, eol - pos).c_str(), nullptr, 16);
+      if (chunk <= 0) break;
+      joined += payload.substr(eol + 2, static_cast<size_t>(chunk));
+      pos = eol + 2 + static_cast<size_t>(chunk) + 2;
+    }
+    payload.swap(joined);
+  }
+
+  if (resp && resp_cap) {
+    size_t n = payload.size() < resp_cap - 1 ? payload.size() : resp_cap - 1;
+    memcpy(resp, payload.data(), n);
+    resp[n] = '\0';
+  }
+  return status;
+}

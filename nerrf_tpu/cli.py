@@ -85,9 +85,18 @@ def cmd_train_detector(args) -> int:
     train_ds = build_dataset(corpus[:-n_eval], ds_cfg)
     eval_ds = build_dataset(corpus[-n_eval:], ds_cfg)
     _log(f"training detector on {len(train_ds)} windows ({args.steps} steps)…")
-    res = train_nerrfnet(train_ds, eval_ds, TrainConfig(
+    train_cfg = TrainConfig(
         model=model_cfg, batch_size=8, num_steps=args.steps,
-        learning_rate=3e-3, warmup_steps=min(30, args.steps // 5)), log=_log)
+        learning_rate=3e-3, warmup_steps=min(30, args.steps // 5))
+    if args.ckpt_every > 0:
+        from nerrf_tpu.train.elastic import train_elastic
+
+        res = train_elastic(
+            train_ds, eval_ds, train_cfg,
+            ckpt_dir=Path(args.model_dir) / "train_state",
+            save_every=args.ckpt_every, log=_log)
+    else:
+        res = train_nerrfnet(train_ds, eval_ds, train_cfg, log=_log)
     _log(f"metrics: edge_auc={res.metrics['edge_auc']:.4f} "
          f"seq_f1={res.metrics['seq_f1']:.4f} ({res.steps_per_sec:.1f} steps/s)")
     save_checkpoint(args.model_dir, res.state.params, model_cfg)
@@ -204,6 +213,15 @@ def cmd_serve(args) -> int:
     from nerrf_tpu.ingest.service import TraceReplayServer
     from nerrf_tpu.observability import MetricsServer
 
+    if args.duration <= 0:
+        # Block BEFORE spawning any thread: child threads inherit the mask,
+        # so process-directed SIGTERM/SIGINT can only wake sigwait below.
+        # Without this the kernel may deliver to a gRPC/metrics thread where
+        # SIGTERM's default disposition hard-kills the process, skipping
+        # cleanup.
+        signal.pthread_sigmask(
+            signal.SIG_BLOCK, {signal.SIGINT, signal.SIGTERM})
+
     trace = _load_any_trace(args.trace)
     host, _, port = args.address.rpartition(":")
     server = TraceReplayServer(trace.events, trace.strings,
@@ -218,10 +236,6 @@ def cmd_serve(args) -> int:
         if args.duration > 0:
             time.sleep(args.duration)
         else:
-            # sigwait only wakes for *blocked* signals; without the mask,
-            # SIGTERM takes its default disposition (hard kill, no cleanup)
-            signal.pthread_sigmask(
-                signal.SIG_BLOCK, {signal.SIGINT, signal.SIGTERM})
             signal.sigwait({signal.SIGINT, signal.SIGTERM})
     finally:
         server.stop()
@@ -232,18 +246,34 @@ def cmd_serve(args) -> int:
 
 def cmd_ingest(args) -> int:
     """Drain a tracker's StreamEvents into a trace store (the AI-side ingest
-    pod: gRPC → native decode → time-bucketed segments)."""
+    pod: gRPC → native decode → time-bucketed segments).  Blocks are appended
+    and flushed incrementally, so a dropped stream or deadline expiry loses
+    nothing already received; --follow reconnects forever (daemon mode)."""
+    import grpc
+
     from nerrf_tpu.graph.store import TraceStore
     from nerrf_tpu.ingest.service import TrackerClient
 
-    client = TrackerClient(args.target)
-    events, strings = client.stream(
-        max_events=args.max_events or None, timeout=args.timeout)
+    total = 0
+    segments = 0
     with TraceStore(args.store_dir, bucket_sec=args.bucket_sec) as st:
-        n = st.append(events, strings)
-        segments = st.flush()
+        while True:
+            client = TrackerClient(args.target)
+            try:
+                for events, strings in client.iter_blocks(
+                        max_events=args.max_events or None,
+                        timeout=args.timeout):
+                    total += st.append(events, strings)
+                    segments += st.flush()
+            except grpc.RpcError as e:
+                # stream end by deadline/disconnect: everything received is
+                # already flushed
+                _log(f"stream ended: {e.code().name}")
+            if not args.follow:
+                break
+            time.sleep(args.reconnect_sec)
         out = {
-            "events": n,
+            "events": total,
             "segments_written": segments,
             "segments_live": st.num_segments,
             "strings": st.num_strings,
@@ -271,6 +301,9 @@ def main(argv=None) -> int:
     p.add_argument("--hidden", type=int, default=48)
     p.add_argument("--layers", type=int, default=4)
     p.add_argument("--seed", type=int, default=21)
+    p.add_argument("--ckpt-every", type=int, default=0,
+                   help="checkpoint the full train state every N steps and "
+                        "resume from the latest on restart (0 = off)")
     p.set_defaults(fn=cmd_train_detector)
 
     p = sub.add_parser("undo", help="detect, plan, rehearse and roll back")
@@ -303,6 +336,9 @@ def main(argv=None) -> int:
     p.add_argument("--bucket-sec", type=float, default=30.0)
     p.add_argument("--max-events", type=int, default=0)
     p.add_argument("--timeout", type=float, default=30.0)
+    p.add_argument("--follow", action="store_true",
+                   help="reconnect and keep draining forever (daemon mode)")
+    p.add_argument("--reconnect-sec", type=float, default=2.0)
     p.set_defaults(fn=cmd_ingest)
 
     args = ap.parse_args(argv)

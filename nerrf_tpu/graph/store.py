@@ -124,15 +124,22 @@ class TraceStore:
                  use_native: Optional[bool] = None) -> None:
         self.root = Path(root)
         self.bucket_ns = int(bucket_sec * 1e9)
-        # a stored BUCKET wins: bucket math must match the on-disk segments
+        # a stored BUCKET wins: bucket math must match the on-disk segments.
+        # Tolerate a corrupt/empty file (crash mid-create) like the native
+        # engine does — fall back to the caller's value.
         bpath = self.root / "BUCKET"
         if bpath.exists():
-            stored = int(bpath.read_text().strip())
+            try:
+                stored = int(bpath.read_text().strip())
+            except ValueError:
+                stored = 0
             if stored > 0:
                 self.bucket_ns = stored
         else:
             self.root.mkdir(parents=True, exist_ok=True)
-            bpath.write_text(f"{self.bucket_ns}\n")
+            tmp = self.root / ".BUCKET.tmp"
+            tmp.write_text(f"{self.bucket_ns}\n")
+            tmp.rename(bpath)
         if use_native is None:
             use_native = store_native_available()
         elif use_native and not store_native_available():

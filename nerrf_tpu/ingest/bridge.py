@@ -267,22 +267,28 @@ class IngestBridge:
     # --- string pool ---------------------------------------------------------
 
     def string_table(self) -> StringTable:
-        """Snapshot the intern pool as a StringTable (ids preserved)."""
+        """The intern pool as a StringTable (ids preserved).  The pool is
+        append-only, so the table is cached and extended incrementally —
+        per-frame callers (iter_blocks) pay only for new strings."""
         if not self._native:
             return self._strings
         size = _LIB.nerrf_pool_size(self._handle)
-        nbytes = _LIB.nerrf_pool_bytes(self._handle)
-        data = ctypes.create_string_buffer(max(nbytes, 1))
-        offsets = (ctypes.c_int64 * (size + 1))()
-        got = _LIB.nerrf_pool_dump(self._handle, data, nbytes, offsets, size + 1)
-        if got != size:
-            raise RuntimeError("pool dump failed")
-        table = StringTable()
-        raw = data.raw[:nbytes]
-        for i in range(size):
-            s = raw[offsets[i] : offsets[i + 1]].decode("utf-8", "replace")
-            if table.intern(s) != i:
-                raise RuntimeError(f"non-contiguous intern pool at id {i}")
+        table = getattr(self, "_table_cache", None)
+        if table is None:
+            table = StringTable()
+            self._table_cache = table
+        if len(table) < size:
+            nbytes = _LIB.nerrf_pool_bytes(self._handle)
+            data = ctypes.create_string_buffer(max(nbytes, 1))
+            offsets = (ctypes.c_int64 * (size + 1))()
+            got = _LIB.nerrf_pool_dump(self._handle, data, nbytes, offsets, size + 1)
+            if got != size:
+                raise RuntimeError("pool dump failed")
+            raw = data.raw[:nbytes]
+            for i in range(len(table), size):
+                s = raw[offsets[i] : offsets[i + 1]].decode("utf-8", "replace")
+                if table.intern(s) != i:
+                    raise RuntimeError(f"non-contiguous intern pool at id {i}")
         return table
 
     def _to_events(self, arrs: dict) -> EventArrays:

@@ -119,11 +119,13 @@ class TrackerClient:
         self._target = target
         self._bridge = bridge or IngestBridge()
 
-    def stream(
+    def iter_blocks(
         self, max_events: Optional[int] = None, timeout: float = 30.0
-    ) -> tuple[EventArrays, StringTable]:
-        """Collect until the stream ends (or max_events reached)."""
-        blocks: list[EventArrays] = []
+    ) -> Iterator[tuple[EventArrays, StringTable]]:
+        """Yield (block, string-table) per decoded frame as it arrives, so
+        callers can persist incrementally — a dropped stream loses only the
+        frame in flight, not the whole session.  The string table is the
+        bridge's cumulative view (ids stable for the client's lifetime)."""
         total = 0
         with grpc.insecure_channel(self._target) as channel:
             call = channel.unary_stream(
@@ -142,10 +144,16 @@ class TrackerClient:
                 DEFAULT_REGISTRY.counter_inc(
                     "ingest_events_total", block.num_valid,
                     help="events decoded from the tracker stream")
-                blocks.append(block)
+                yield block, self._bridge.string_table()
                 total += block.num_valid
                 if max_events is not None and total >= max_events:
                     call.cancel()
                     break
+
+    def stream(
+        self, max_events: Optional[int] = None, timeout: float = 30.0
+    ) -> tuple[EventArrays, StringTable]:
+        """Collect until the stream ends (or max_events reached)."""
+        blocks = [b for b, _ in self.iter_blocks(max_events, timeout)]
         events = EventArrays.concatenate(blocks) if blocks else EventArrays.empty(0)
         return events, self._bridge.string_table()

@@ -83,14 +83,55 @@ class FirecrackerDriver:
     """
 
     def __init__(self, firecracker_bin: str = "firecracker",
-                 kernel_image: Optional[str] = None) -> None:
+                 kernel_image: Optional[str] = None,
+                 api_socket: str = "/tmp/nerrf-fc.sock") -> None:
         self.bin = firecracker_bin
         self.kernel_image = kernel_image
+        self.api_socket = api_socket
 
     @staticmethod
     def available() -> bool:
         import os
         return os.path.exists("/dev/kvm") and shutil.which("firecracker") is not None
+
+    def boot_clone(self, rootfs_image: str, vcpus: int = 1, mem_mib: int = 256,
+                   socket_wait_sec: float = 5.0):  # pragma: no cover - requires KVM host
+        """Spawn firecracker and drive its API (native C++ transport,
+        nerrf_tpu/rollback/fc.py) through the spec's replay sequence:
+        machine-config → boot-source → rootfs drive → InstanceStart."""
+        import os
+        import subprocess
+        import time
+
+        from nerrf_tpu.rollback.fc import FirecrackerAPI
+
+        if self.kernel_image is None:
+            raise ValueError("FirecrackerDriver needs kernel_image to boot")
+        # firecracker refuses to start over a stale socket from a prior run
+        Path(self.api_socket).unlink(missing_ok=True)
+        proc = subprocess.Popen([self.bin, "--api-sock", self.api_socket])
+        try:
+            deadline = time.monotonic() + socket_wait_sec
+            while not os.path.exists(self.api_socket):
+                if proc.poll() is not None:
+                    raise RuntimeError(
+                        f"firecracker exited (rc={proc.returncode}) before "
+                        "creating its API socket")
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"firecracker API socket {self.api_socket} not "
+                        f"created within {socket_wait_sec}s")
+                time.sleep(0.05)
+            api = FirecrackerAPI(self.api_socket)
+            api.configure_machine(vcpus=vcpus, mem_mib=mem_mib)
+            api.set_boot_source(self.kernel_image)
+            api.add_drive("rootfs", rootfs_image, root=True)
+            api.start()
+        except BaseException:
+            proc.kill()
+            proc.wait()
+            raise
+        return proc, api
 
     def rehearse(self, *a, **kw):  # pragma: no cover - requires KVM host
         raise RuntimeError(

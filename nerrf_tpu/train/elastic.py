@@ -1,0 +1,189 @@
+"""Elastic training: periodic full-state checkpoints, resume, fault injection.
+
+The reference has no elastic-recovery story — its infra resilience is
+"Kubernetes restarts the pod" (`/root/reference/docs/content/docs/architecture.mdx:29`;
+SURVEY.md §5) and its only fault injection is the attack simulator itself.
+For a TPU pod, preemption is routine, so training must be resumable with
+*bit-identical* results: an interrupted-and-resumed run produces the same
+parameters as an uninterrupted one.
+
+Design for determinism under restart:
+  * per-step randomness is *derived*, never threaded: batch order comes from
+    ``np.random.default_rng((seed, step))`` and dropout keys from
+    ``jax.random.fold_in(base, step)`` — so step N's randomness is identical
+    no matter how many restarts preceded it;
+  * checkpoints hold the full ``TrainState`` (params + optimizer state +
+    step) via orbax, written step-dir-atomically: the ``meta.json`` sidecar
+    is written last and is the scanner's commit marker;
+  * a heartbeat file updated at every save supports external failure
+    detection (`stale_heartbeat`), the host-side analogue of a missing
+    DaemonSet liveness probe.
+
+Fault injection for tests/drills: pass ``fault=Preemption.at(step)`` and the
+loop raises mid-run exactly once, after the step's optimizer update but
+before its checkpoint — the worst-case window.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import time
+from pathlib import Path
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import orbax.checkpoint as ocp
+
+from nerrf_tpu.models.joint import NerrfNet
+from nerrf_tpu.train.data import WindowDataset
+from nerrf_tpu.train.loop import (
+    TrainConfig,
+    TrainResult,
+    evaluate,
+    init_state,
+    make_eval_fn,
+    make_train_step,
+)
+
+
+class Preemption(Exception):
+    """Simulated preemption (fault injection for recovery drills)."""
+
+    def __init__(self, step: int) -> None:
+        super().__init__(f"simulated preemption at step {step}")
+        self.step = step
+
+
+@dataclasses.dataclass
+class _FaultAt:
+    fail_at: int
+    fired: bool = False
+
+    def __call__(self, step: int) -> None:
+        if not self.fired and step == self.fail_at:
+            self.fired = True
+            raise Preemption(step)
+
+
+def fault_at(step: int) -> _FaultAt:
+    """A fault injector that preempts once at `step`."""
+    return _FaultAt(step)
+
+
+# --------------------------------------------------------------------------
+# checkpoint dir layout: <dir>/step_<n>/{state/, meta.json}; meta last.
+# --------------------------------------------------------------------------
+
+def _save_full(ckpt_dir: Path, step: int, state) -> None:
+    out = ckpt_dir / f"step_{step:08d}"
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(out.absolute() / "state",
+                   jax.device_get({"params": state.params,
+                                   "opt_state": state.opt_state}),
+                   force=True)
+    (out / "meta.json").write_text(json.dumps({"step": step}) + "\n")
+    _heartbeat(ckpt_dir, step)
+
+
+def latest_step(ckpt_dir: str | Path) -> Optional[int]:
+    """Highest committed checkpoint step, or None."""
+    best = None
+    for p in Path(ckpt_dir).glob("step_*"):
+        if (p / "meta.json").exists():
+            step = json.loads((p / "meta.json").read_text())["step"]
+            best = step if best is None else max(best, step)
+    return best
+
+
+def _restore_full(ckpt_dir: Path, step: int, template_state):
+    target = jax.device_get({"params": template_state.params,
+                             "opt_state": template_state.opt_state})
+    with ocp.StandardCheckpointer() as ckptr:
+        got = ckptr.restore(
+            (ckpt_dir / f"step_{step:08d}").absolute() / "state", target)
+    return template_state.replace(
+        step=step, params=got["params"], opt_state=got["opt_state"])
+
+
+def _heartbeat(ckpt_dir: Path, step: int) -> None:
+    tmp = ckpt_dir / ".heartbeat.tmp"
+    tmp.write_text(json.dumps({"step": step, "ts": time.time()}) + "\n")
+    tmp.rename(ckpt_dir / "heartbeat.json")
+
+
+def stale_heartbeat(ckpt_dir: str | Path, timeout_sec: float) -> bool:
+    """Failure detection: True if no heartbeat within `timeout_sec` (or none
+    at all) — the signal an external supervisor uses to reschedule."""
+    p = Path(ckpt_dir) / "heartbeat.json"
+    if not p.exists():
+        return True
+    hb = json.loads(p.read_text())
+    return (time.time() - hb["ts"]) > timeout_sec
+
+
+# --------------------------------------------------------------------------
+
+def train_elastic(
+    train_ds: WindowDataset,
+    eval_ds: Optional[WindowDataset] = None,
+    cfg: Optional[TrainConfig] = None,
+    ckpt_dir: str | Path = "checkpoints",
+    save_every: int = 50,
+    fault=None,
+    log=None,
+) -> TrainResult:
+    """Run (or resume) training to `cfg.num_steps` with periodic full-state
+    checkpoints.  Restartable at any point; deterministic across restarts."""
+    cfg = cfg or TrainConfig()
+    ckpt_dir = Path(ckpt_dir)
+    ckpt_dir.mkdir(parents=True, exist_ok=True)
+
+    model = NerrfNet(cfg.model)
+    base_rng = jax.random.PRNGKey(cfg.seed)
+    # init key far outside the per-step fold_in range [0, num_steps)
+    state = init_state(model, cfg, train_ds.arrays,
+                       jax.random.fold_in(base_rng, 0x7FFFFFFF))
+    start = latest_step(ckpt_dir)
+    if start is not None:
+        state = _restore_full(ckpt_dir, start, state)
+        if log:
+            log(f"resumed from step {start}")
+    else:
+        start = 0
+
+    train_step = make_train_step(model, cfg)
+    n = len(train_ds)
+    history = []
+    t_start = None
+    loss = None
+    for step in range(start, cfg.num_steps):
+        # derived randomness: identical for step N on every (re)run
+        order = np.random.default_rng((cfg.seed, step))
+        idx = order.choice(n, size=min(cfg.batch_size, n), replace=False)
+        batch = {k: jnp.asarray(v[idx]) for k, v in train_ds.arrays.items()}
+        step_rng = jax.random.fold_in(base_rng, step)
+        state, loss, aux, _ = train_step(state, batch, step_rng)
+        if t_start is None:
+            jax.block_until_ready(loss)
+            t_start = time.perf_counter()
+        if fault is not None:
+            fault(step)
+        done = step + 1
+        if done % save_every == 0 or done == cfg.num_steps:
+            _save_full(ckpt_dir, done, state)
+            history.append({"step": step, "loss": float(loss)})
+            if log:
+                log(f"step {step}: loss={float(loss):.4f} (checkpointed)")
+
+    jax.block_until_ready(state.params)
+    elapsed = time.perf_counter() - (t_start or time.perf_counter())
+    steps = cfg.num_steps - start
+    steps_per_sec = max(steps - 1, 1) / elapsed if elapsed > 0 else 0.0
+    metrics = evaluate(
+        make_eval_fn(model), state.params,
+        eval_ds if eval_ds is not None else train_ds, cfg.batch_size)
+    return TrainResult(state=state, metrics=metrics,
+                       steps_per_sec=steps_per_sec, history=history)

@@ -1,0 +1,130 @@
+"""Firecracker API client (native + fallback) against a fake unix-socket
+Firecracker: request framing, workflow sequence, error surfacing."""
+
+import http.server
+import json
+import socketserver
+import threading
+
+import pytest
+
+from nerrf_tpu.rollback.fc import FirecrackerAPI, fc_native_available
+
+ENGINES = ["python"] + (["native"] if fc_native_available() else [])
+
+
+class _FakeFirecracker:
+    """Unix-socket HTTP server recording the API calls it receives."""
+
+    def __init__(self, sock_path):
+        self.calls = []
+        outer = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def _record(self, method):
+                length = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(length).decode() if length else ""
+                outer.calls.append(
+                    (method, self.path, json.loads(body) if body else None))
+
+            def _reply(self, status, payload=b""):
+                self.send_response(status)
+                if payload:
+                    self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(payload)))
+                self.send_header("Connection", "close")
+                self.end_headers()
+                if payload:
+                    self.wfile.write(payload)
+
+            def do_GET(self):  # noqa: N802
+                self._record("GET")
+                self._reply(200, json.dumps(
+                    {"id": "fake-fc", "state": "Running",
+                     "vmm_version": "1.0-fake"}).encode())
+
+            def do_PUT(self):  # noqa: N802
+                self._record("PUT")
+                if self.path == "/bad":
+                    self._reply(400, b'{"fault_message": "nope"}')
+                else:
+                    self._reply(204)
+
+            def do_PATCH(self):  # noqa: N802
+                self._record("PATCH")
+                self._reply(204)
+
+            def log_message(self, *a):
+                del a
+
+        class Server(socketserver.ThreadingMixIn, socketserver.UnixStreamServer):
+            daemon_threads = True
+
+            def get_request(self):
+                request, _ = super().get_request()
+                # BaseHTTPRequestHandler wants a (host, port)-ish client addr
+                return request, ("127.0.0.1", 0)
+
+        self.server = Server(str(sock_path), Handler)
+        self.thread = threading.Thread(target=self.server.serve_forever,
+                                       daemon=True)
+        self.thread.start()
+
+    def close(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+
+@pytest.fixture
+def fake_fc(tmp_path):
+    sock = tmp_path / "fc.sock"
+    srv = _FakeFirecracker(sock)
+    yield sock, srv
+    srv.close()
+
+
+@pytest.mark.parametrize("engine", ENGINES)
+def test_workflow_sequence(fake_fc, engine):
+    sock, srv = fake_fc
+    api = FirecrackerAPI(str(sock), use_native=(engine == "native"))
+    info = api.describe()
+    assert info["id"] == "fake-fc"
+    api.configure_machine(vcpus=2, mem_mib=512)
+    api.set_boot_source("/img/vmlinux")
+    api.add_drive("rootfs", "/img/rootfs.ext4", root=True)
+    api.start()
+    api.pause()
+    api.snapshot("/snap/vmstate", "/snap/mem")
+
+    methods = [(m, p) for m, p, _ in srv.calls]
+    assert methods == [
+        ("GET", "/"),
+        ("PUT", "/machine-config"),
+        ("PUT", "/boot-source"),
+        ("PUT", "/drives/rootfs"),
+        ("PUT", "/actions"),
+        ("PATCH", "/vm"),
+        ("PUT", "/snapshot/create"),
+    ]
+    bodies = {p: b for _, p, b in srv.calls if b}
+    assert bodies["/machine-config"] == {"vcpu_count": 2, "mem_size_mib": 512}
+    assert bodies["/drives/rootfs"]["is_root_device"] is True
+    assert bodies["/actions"] == {"action_type": "InstanceStart"}
+
+
+@pytest.mark.parametrize("engine", ENGINES)
+def test_api_error_is_surfaced(fake_fc, engine):
+    sock, _ = fake_fc
+    api = FirecrackerAPI(str(sock), use_native=(engine == "native"))
+    with pytest.raises(RuntimeError, match="HTTP 400"):
+        api._expect("PUT", "/bad", {"x": 1})
+
+
+@pytest.mark.parametrize("engine", ENGINES)
+def test_connect_failure(tmp_path, engine):
+    api = FirecrackerAPI(str(tmp_path / "absent.sock"),
+                         use_native=(engine == "native"))
+    with pytest.raises(OSError):
+        api.request("GET", "/")
