@@ -96,19 +96,61 @@ def make_loss_fn(model: NerrfNet, cfg: TrainConfig):
     return loss_fn
 
 
+def _step_body(loss_fn, state: train_state.TrainState, batch, rng):
+    """The one grad/update body shared by every batching strategy."""
+    rng, dropout_rng = jax.random.split(rng)
+    (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+        state.params, batch, dropout_rng
+    )
+    state = state.apply_gradients(grads=grads)
+    return state, loss, aux, rng
+
+
 def make_train_step(model: NerrfNet, cfg: TrainConfig):
     loss_fn = make_loss_fn(model, cfg)
 
     @partial(jax.jit, donate_argnums=(0,))
     def train_step(state: train_state.TrainState, batch, rng):
-        rng, dropout_rng = jax.random.split(rng)
-        (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-            state.params, batch, dropout_rng
-        )
-        state = state.apply_gradients(grads=grads)
-        return state, loss, aux, rng
+        return _step_body(loss_fn, state, batch, rng)
 
     return train_step
+
+
+def make_train_step_resident(model: NerrfNet, cfg: TrainConfig, arrays):
+    """Train step over an HBM-resident dataset: the full window arrays are
+    device_put once and passed as jit *parameters* (closure capture would
+    fold them into the HLO as constants and blow up compile time); each step
+    gathers its batch on device, so per-step host→device traffic is just the
+    [batch] index vector — on TPU this removes the transfer of ~MBs of
+    padded windows from the critical path."""
+    loss_fn = make_loss_fn(model, cfg)
+    dev = {k: jax.device_put(v) for k, v in arrays.items()}
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def train_step(state: train_state.TrainState, idx, rng, data):
+        batch = {k: jnp.take(v, idx, axis=0) for k, v in data.items()}
+        return _step_body(loss_fn, state, batch, rng)
+
+    def step(state, idx, rng):
+        return train_step(state, idx, rng, dev)
+
+    return step
+
+
+# Datasets larger than this stream batches from host instead of living in
+# device memory (override: NERRF_RESIDENT_MAX_BYTES).
+RESIDENT_MAX_BYTES = 2 << 30
+
+
+def _dataset_bytes(arrays) -> int:
+    return sum(int(v.nbytes) for v in arrays.values())
+
+
+def _fits_resident(arrays) -> bool:
+    import os
+
+    limit = int(os.environ.get("NERRF_RESIDENT_MAX_BYTES", RESIDENT_MAX_BYTES))
+    return _dataset_bytes(arrays) <= limit
 
 
 def make_eval_fn(model: NerrfNet):
@@ -188,7 +230,12 @@ def train_nerrfnet(
     rng = jax.random.PRNGKey(cfg.seed)
     rng, init_rng = jax.random.split(rng)
     state = init_state(model, cfg, train_ds.arrays, init_rng)
-    train_step = make_train_step(model, cfg)
+    # HBM-resident fast path when the dataset fits; stream batches otherwise
+    resident = _fits_resident(train_ds.arrays)
+    if resident:
+        train_step = make_train_step_resident(model, cfg, train_ds.arrays)
+    else:
+        train_step = make_train_step(model, cfg)
     eval_fn = make_eval_fn(model)
 
     n = len(train_ds)
@@ -198,8 +245,11 @@ def train_nerrfnet(
     t_start = None
     for step in range(cfg.num_steps):
         idx = order_rng.choice(n, size=min(cfg.batch_size, n), replace=False)
-        batch = {k: jnp.asarray(v[idx]) for k, v in train_ds.arrays.items()}
-        state, loss, aux, rng = train_step(state, batch, rng)
+        if resident:
+            state, loss, aux, rng = train_step(state, jnp.asarray(idx), rng)
+        else:
+            batch = {k: jnp.asarray(v[idx]) for k, v in train_ds.arrays.items()}
+            state, loss, aux, rng = train_step(state, batch, rng)
         if step == 0:
             jax.block_until_ready(loss)
             t_start = time.perf_counter()

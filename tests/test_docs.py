@@ -1,0 +1,47 @@
+"""Docs stay truthful: pages exist, internal links resolve, and the CLI/
+module entry points they reference actually exist."""
+
+import re
+
+
+def test_all_pages_present_and_linked(repo_root):
+    docs = repo_root / "docs"
+    pages = {p.name for p in docs.glob("*.md")}
+    assert {"index.md", "quick-start.md", "architecture.md", "ingest.md",
+            "models.md", "planner.md", "rollback.md", "scaling.md",
+            "operations.md", "benchmarks.md", "configuration.md"} <= pages
+    # every relative .md link in every page resolves
+    for p in docs.glob("*.md"):
+        for target in re.findall(r"\]\(([\w\-]+\.md)\)", p.read_text()):
+            assert (docs / target).exists(), f"{p.name} links missing {target}"
+
+
+def test_referenced_cli_commands_exist(repo_root):
+    import nerrf_tpu.cli as cli
+
+    pages = list((repo_root / "docs").glob("*.md")) + [repo_root / "README.md"]
+    text = "".join(p.read_text() for p in pages)
+    referenced = set(re.findall(r"nerrf_tpu\.cli (\w[\w-]*)", text))
+    parser_cmds = {"simulate", "train-detector", "undo", "status", "serve",
+                   "ingest"}
+    assert referenced <= parser_cmds
+    # and the parser really accepts them
+    for cmd in parser_cmds:
+        try:
+            cli.main([cmd, "--help"])
+        except SystemExit as e:
+            assert e.code == 0, f"cli {cmd} --help failed"
+
+
+def test_referenced_modules_exist(repo_root):
+    """Every nerrf_tpu module referenced in docs — dotted (`nerrf_tpu.x.y`)
+    or path-style (`nerrf_tpu/x/y.py`) — must import."""
+    import importlib
+
+    text = "".join(p.read_text() for p in (repo_root / "docs").glob("*.md"))
+    mods = set(re.findall(r"\bnerrf_tpu(?:\.\w+)+\b", text))
+    for path in re.findall(r"\bnerrf_tpu(?:/\w+)+\.py\b", text):
+        mods.add(path[:-3].replace("/", "."))
+    assert len(mods) >= 10, f"docs module-reference scan looks broken: {mods}"
+    for mod in sorted(mods):
+        importlib.import_module(mod)
