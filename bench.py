@@ -99,6 +99,29 @@ def main() -> None:
     log(f"[bench] eval: edge_auc={metrics['edge_auc']:.4f} "
         f"seq_auc={metrics['seq_auc']:.4f} seq_f1={metrics['seq_f1']:.4f}")
 
+    # --- MCTS planner: rollouts/s with the TPU value net --------------------
+    # (BASELINE.json metric of record; M1-scale incident: 45 files, 4 procs)
+    from nerrf_tpu.planner import MCTSConfig, MCTSPlanner, UndoDomain
+    from nerrf_tpu.planner.value_net import ValueNet
+
+    prng = np.random.default_rng(7)
+    F, P = 45, 4
+    domain = UndoDomain(
+        file_paths=[f"/app/uploads/doc_{i}.lockbit3" for i in range(F)],
+        file_scores=prng.beta(0.4, 0.4, F).astype(np.float32),
+        file_loss_mb=prng.uniform(2.0, 5.0, F).astype(np.float32),
+        proc_names=[f"{4000 + p}:python3" for p in range(P)],
+        proc_scores=np.array([0.95] + [0.1] * (P - 1), np.float32),
+        max_steps=64,
+    )
+    vnet = ValueNet.create()
+    vnet.fit_to_domain(domain, num_rollouts=256, steps=150)
+    planner = MCTSPlanner(domain, value_fn=vnet,
+                          cfg=MCTSConfig(num_simulations=800, batch_size=128))
+    plan = planner.plan()
+    log(f"[bench] mcts: {plan.rollouts} rollouts @ "
+        f"{plan.rollouts_per_sec:.0f}/s, {len(plan.actions)} actions")
+
     # --- torch baseline (same architecture, this host) ----------------------
     vs_baseline = None
     torch_sps = None
@@ -123,6 +146,7 @@ def main() -> None:
         "backend": backend,
         "edge_roc_auc": round(metrics["edge_auc"], 4),
         "seq_f1": round(metrics["seq_f1"], 4),
+        "mcts_rollouts_per_sec": round(plan.rollouts_per_sec, 1),
         "torch_cpu_steps_per_sec": round(torch_sps, 3) if torch_sps else None,
         "wall_seconds": round(time.perf_counter() - t_wall, 1),
     }))

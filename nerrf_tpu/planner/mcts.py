@@ -24,7 +24,13 @@ from nerrf_tpu.planner.value_net import HeuristicValue, ValueFn
 @dataclasses.dataclass(frozen=True)
 class MCTSConfig:
     num_simulations: int = 800          # spec band: 500–1000
-    batch_size: int = 32                # frontier leaves per device dispatch
+    # Frontier leaves per device dispatch.  Each dispatch pays a fixed
+    # host→device round trip (large over a remote tunnel), so bigger batches
+    # amortize it: measured on TPU (M1-scale domain, 800 sims) 32→303,
+    # 64→530, 128→692 rollouts/s, all yielding identical plans (virtual loss
+    # keeps concurrent selections diverse).  64 is the default to stay
+    # conservative on small action spaces; bench.py uses 128.
+    batch_size: int = 64
     c_puct: float = 1.5
     virtual_loss: float = 3.0
     max_nodes: int = 4096

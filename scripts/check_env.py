@@ -1,0 +1,117 @@
+#!/usr/bin/env python3
+"""Environment doctor: verify everything the framework needs, report clearly.
+
+The runnable counterpart of the reference's 372-line distro-installer
+(`/root/reference/tracker/scripts/install-deps.sh`): rather than mutating the
+host, it *checks* — Python deps, JAX backend and device count, the native
+toolchain, the built (or buildable) C++ libraries, protoc, and optional
+capture/sandbox capabilities (BPF clang target, /dev/kvm + firecracker) —
+and prints one line per requirement plus a machine-readable JSON summary.
+
+Exit code 0 iff every REQUIRED row passes.
+
+Usage: python scripts/check_env.py [--json]
+"""
+
+from __future__ import annotations
+
+import importlib
+import json
+import os
+import shutil
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+REQUIRED_MODULES = ["jax", "flax", "optax", "orbax.checkpoint", "numpy",
+                    "grpc", "google.protobuf"]
+OPTIONAL_MODULES = ["torch", "pandas", "pyarrow", "yaml", "chex", "einops"]
+
+
+def check(name, fn, required=True):
+    try:
+        detail = fn()
+        return {"name": name, "ok": True, "required": required,
+                "detail": str(detail or "")}
+    except Exception as e:
+        return {"name": name, "ok": False, "required": required,
+                "detail": f"{type(e).__name__}: {e}"}
+
+
+def _module(mod):
+    def fn():
+        m = importlib.import_module(mod)
+        return getattr(m, "__version__", "present")
+    return fn
+
+
+def _jax_backend():
+    import jax
+
+    devices = jax.devices()
+    return f"{jax.default_backend()} x{len(devices)} ({devices[0].device_kind})"
+
+
+def _toolchain(tool):
+    def fn():
+        path = shutil.which(tool)
+        if not path:
+            raise FileNotFoundError(tool)
+        return path
+    return fn
+
+
+def _native_libs():
+    out = subprocess.run(["make", "-s", "all"], cwd=os.path.join(REPO, "native"),
+                         capture_output=True, text=True, timeout=180)
+    if out.returncode != 0:
+        raise RuntimeError(out.stderr.strip()[-200:])
+    libs = sorted(os.listdir(os.path.join(REPO, "native", "build")))
+    return ", ".join(l for l in libs if l.endswith(".so"))
+
+
+def _bpf_target():
+    out = subprocess.run(["make", "-s", "bpf"], cwd=os.path.join(REPO, "native"),
+                         capture_output=True, text=True, timeout=120)
+    if out.returncode != 0:
+        raise RuntimeError("clang BPF target unavailable (host capture only)")
+    return "tracepoints.o"
+
+
+def _kvm():
+    if not os.path.exists("/dev/kvm"):
+        raise FileNotFoundError("/dev/kvm (filesystem-clone sandbox will be used)")
+    if shutil.which("firecracker") is None:
+        raise FileNotFoundError("firecracker binary")
+    return "microVM sandbox available"
+
+
+def main() -> int:
+    rows = []
+    for mod in REQUIRED_MODULES:
+        rows.append(check(f"python:{mod}", _module(mod)))
+    for mod in OPTIONAL_MODULES:
+        rows.append(check(f"python:{mod}", _module(mod), required=False))
+    rows.append(check("jax:backend", _jax_backend))
+    for tool in ("g++", "make"):
+        rows.append(check(f"toolchain:{tool}", _toolchain(tool)))
+    for tool in ("clang", "protoc", "cmake", "ninja"):
+        rows.append(check(f"toolchain:{tool}", _toolchain(tool), required=False))
+    rows.append(check("native:libraries", _native_libs))
+    rows.append(check("native:bpf-target", _bpf_target, required=False))
+    rows.append(check("sandbox:kvm+firecracker", _kvm, required=False))
+
+    ok = all(r["ok"] for r in rows if r["required"])
+    if "--json" in sys.argv:
+        print(json.dumps({"ok": ok, "checks": rows}, indent=2))
+    else:
+        for r in rows:
+            mark = "ok " if r["ok"] else ("FAIL" if r["required"] else "skip")
+            print(f"[{mark}] {r['name']:28s} {r['detail']}")
+        print(f"\nenvironment {'OK' if ok else 'NOT OK'}")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -4,7 +4,6 @@ agrees with the checked-in stubs used by the ingest layer."""
 
 import shutil
 import subprocess
-import sys
 
 import pytest
 
@@ -25,30 +24,41 @@ def test_proto_compiles_for_python(tmp_path, repo_root):
 
 @needs_protoc
 def test_generated_module_matches_checked_in_semantics(tmp_path, repo_root):
-    """Field numbers/names of the freshly generated Event must match the
-    checked-in nerrf_tpu/ingest/trace_pb2.py the bridge decodes against."""
+    """Field numbers/names of the freshly compiled Event must match the
+    checked-in nerrf_tpu/ingest/trace_pb2.py the bridge decodes against.
+
+    The fresh compile goes through --descriptor_set_out into a *private*
+    descriptor pool: importing a second generated trace_pb2 would collide
+    with the checked-in stub's registration in the default pool and turn any
+    drift into an opaque 'duplicate file name' TypeError."""
+    dset = tmp_path / "trace.dset"
     subprocess.run(
-        ["protoc", f"-I{repo_root / 'proto'}", "--python_out", str(tmp_path),
+        ["protoc", f"-I{repo_root / 'proto'}", "--include_imports",
+         "--descriptor_set_out", str(dset),
          str(repo_root / "proto" / "trace.proto")],
         check=True, capture_output=True,
     )
-    sys.path.insert(0, str(tmp_path))
-    try:
-        for mod in list(sys.modules):
-            if mod == "trace_pb2":
-                del sys.modules[mod]
-        import trace_pb2 as fresh  # generated just now
-    finally:
-        sys.path.pop(0)
+    from google.protobuf import descriptor_pb2, descriptor_pool
+
+    fds = descriptor_pb2.FileDescriptorSet()
+    fds.ParseFromString(dset.read_bytes())
+    pool = descriptor_pool.DescriptorPool()
+    for f in fds.file:
+        pool.Add(f)
+    fresh_file = pool.FindFileByName("trace.proto")
 
     from nerrf_tpu.ingest import trace_pb2 as checked_in
 
-    def fields(mod, message):
-        desc = getattr(mod, message).DESCRIPTOR
+    def fresh_fields(message):
+        desc = fresh_file.message_types_by_name[message]
+        return {(f.name, f.number, f.type) for f in desc.fields}
+
+    def checked_fields(message):
+        desc = getattr(checked_in, message).DESCRIPTOR
         return {(f.name, f.number, f.type) for f in desc.fields}
 
     for message in ("Event", "EventBatch", "Empty"):
-        assert fields(fresh, message) == fields(checked_in, message), message
+        assert fresh_fields(message) == checked_fields(message), message
 
     svc = checked_in.DESCRIPTOR.services_by_name["Tracker"]
     assert [m.name for m in svc.methods] == ["StreamEvents"]
