@@ -33,9 +33,10 @@ from jax.sharding import Mesh, PartitionSpec as P
 _NEG = -1e30
 
 
-def _attention_local(q, k, v, causal: bool) -> jnp.ndarray:
-    """Plain single-shard attention; the reference semantics ring attention
-    must reproduce.  q,k,v: [B, T, H, D] → [B, T, H, D]."""
+def _attention_dense(q, k, v, causal: bool) -> jnp.ndarray:
+    """Plain materialized attention — the reference semantics both the ring
+    and the blockwise local path must reproduce.  O(T²) memory: use only for
+    tests/small shapes.  q,k,v: [B, T, H, D] → [B, T, H, D]."""
     scale = q.shape[-1] ** -0.5
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
     if causal:
@@ -44,6 +45,63 @@ def _attention_local(q, k, v, causal: bool) -> jnp.ndarray:
         scores = jnp.where(mask[None, None], scores, _NEG)
     w = jax.nn.softmax(scores, axis=-1)
     return jnp.einsum("bhqk,bkhd->bqhd", w, v)
+
+
+_LOCAL_BLOCK = 512
+
+
+def _attention_local(q, k, v, causal: bool) -> jnp.ndarray:
+    """Exact single-device attention, blockwise (flash-style): scan over key
+    blocks with an online-softmax accumulator, so peak memory is
+    O(T·block) — never the [B, H, T, T] score tensor, which at 6×4096
+    stream shapes is gigabytes of HBM traffic per layer.  Matmuls run in the
+    input dtype (bf16 on TPU → MXU rate); accumulation is float32."""
+    b, t, h, d = q.shape
+    if t <= 2 * _LOCAL_BLOCK:
+        return _attention_dense(
+            q.astype(jnp.float32), k.astype(jnp.float32),
+            v.astype(jnp.float32), causal).astype(q.dtype)
+    block = _LOCAL_BLOCK
+    pad = (-t) % block
+    if pad:
+        k = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
+    nb = k.shape[1] // block
+    scale = d ** -0.5
+    q_pos = jax.lax.broadcasted_iota(jnp.int32, (t, 1), 0)
+
+    k_blocks = k.reshape(b, nb, block, h, d).transpose(1, 0, 2, 3, 4)
+    v_blocks = v.reshape(b, nb, block, h, d).transpose(1, 0, 2, 3, 4)
+
+    o0 = jnp.zeros((b, t, h, d), jnp.float32)
+    m0 = jnp.full((b, h, t), -1e9, jnp.float32)
+    l0 = jnp.zeros((b, h, t), jnp.float32)
+
+    def step(carry, blk):
+        o, m, l, j = carry
+        k_blk, v_blk = blk
+        # bf16 operands on the MXU, f32 accumulation; the mask constant stays
+        # far inside range (bf16 cotangents through ±1e30 NaN on TPU)
+        scores = jnp.einsum(
+            "bqhd,bkhd->bhqk", q, k_blk,
+            preferred_element_type=jnp.float32) * scale
+        k_pos = j * block + jax.lax.broadcasted_iota(jnp.int32, (1, block), 1)
+        valid = k_pos < t  # padded key tail
+        if causal:
+            valid = valid & (k_pos <= q_pos)
+        scores = jnp.where(valid[None, None], scores, -1e9)
+        m_new = jnp.maximum(m, scores.max(axis=-1))
+        pexp = jnp.exp(scores - m_new[..., None])
+        alpha = jnp.exp(m - m_new)
+        l = alpha * l + pexp.sum(axis=-1)
+        o = alpha.transpose(0, 2, 1)[..., None] * o + jnp.einsum(
+            "bhqk,bkhd->bqhd", pexp, v_blk,
+            preferred_element_type=jnp.float32)
+        return (o, m_new, l, j + 1), None
+
+    (o, m, l, _), _ = jax.lax.scan(step, (o0, m0, l0, 0), (k_blocks, v_blocks))
+    out = o / jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
 
 
 def _ring_shard(q, k, v, *, axis_name: str, manual_axes: tuple, causal: bool) -> jnp.ndarray:
@@ -105,11 +163,9 @@ def ring_self_attention(
     ICI.  B stays sharded over ``dp`` (no communication on that axis).
     """
     if mesh is None or mesh.shape.get(seq_axis, 1) == 1:
-        out = _attention_local(
-            q.astype(jnp.float32), k.astype(jnp.float32), v.astype(jnp.float32),
-            causal,
-        )
-        return out.astype(q.dtype)
+        # blockwise local path: keeps matmul inputs in their compute dtype
+        # (bf16 → MXU rate) and accumulates in f32 internally
+        return _attention_local(q, k, v, causal)
 
     spec = P(batch_axis, seq_axis, None, None)
     fn = jax.shard_map(

@@ -112,6 +112,12 @@ def make_stream_train_step(model, mesh: Mesh, learning_rate: float = 1e-3):
     tx = optax.adamw(learning_rate)
 
     def place(batch):
+        # On a 1-device mesh, committed NamedSharding inputs push jit down a
+        # much slower dispatch path on remote backends (measured 164→1345
+        # ms/step via the axon tunnel); plain device_put is semantically
+        # identical there.
+        if mesh.size == 1:
+            return {k: jax.device_put(jnp.asarray(v)) for k, v in batch.items()}
         return {k: jax.device_put(jnp.asarray(v), sh[k]) for k, v in batch.items()}
 
     def init_fn(rng, placed_batch):

@@ -42,10 +42,12 @@ from nerrf_tpu.train.data import WindowDataset
 from nerrf_tpu.train.loop import (
     TrainConfig,
     TrainResult,
+    _fits_resident,
     evaluate,
     init_state,
     make_eval_fn,
     make_train_step,
+    make_train_step_resident,
 )
 
 
@@ -154,7 +156,9 @@ def train_elastic(
     else:
         start = 0
 
-    train_step = make_train_step(model, cfg)
+    resident = _fits_resident(train_ds.arrays)
+    train_step = (make_train_step_resident(model, cfg, train_ds.arrays)
+                  if resident else make_train_step(model, cfg))
     n = len(train_ds)
     history = []
     t_start = None
@@ -163,9 +167,12 @@ def train_elastic(
         # derived randomness: identical for step N on every (re)run
         order = np.random.default_rng((cfg.seed, step))
         idx = order.choice(n, size=min(cfg.batch_size, n), replace=False)
-        batch = {k: jnp.asarray(v[idx]) for k, v in train_ds.arrays.items()}
         step_rng = jax.random.fold_in(base_rng, step)
-        state, loss, aux, _ = train_step(state, batch, step_rng)
+        if resident:
+            state, loss, aux, _ = train_step(state, jnp.asarray(idx), step_rng)
+        else:
+            batch = {k: jnp.asarray(v[idx]) for k, v in train_ds.arrays.items()}
+            state, loss, aux, _ = train_step(state, batch, step_rng)
         if t_start is None:
             jax.block_until_ready(loss)
             t_start = time.perf_counter()

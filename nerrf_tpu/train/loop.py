@@ -123,18 +123,60 @@ def make_train_step_resident(model: NerrfNet, cfg: TrainConfig, arrays):
     gathers its batch on device, so per-step host→device traffic is just the
     [batch] index vector — on TPU this removes the transfer of ~MBs of
     padded windows from the critical path."""
+    step, _ = _make_resident_steps(model, cfg, arrays)
+    return step
+
+
+def make_train_step_scheduled(model: NerrfNet, cfg: TrainConfig, arrays,
+                              idx_table: np.ndarray):
+    """Fully device-driven training: the HBM-resident dataset *and* the whole
+    batch-index schedule live on device, and each step picks its row with
+    ``state.step`` — so a step issues zero host→device transfers and back-to-
+    back steps pipeline instead of syncing on per-step input uploads (the
+    dominant cost over a remote-dispatch link).  ``idx_table`` is
+    [num_steps, batch] int32."""
+    _, make_scheduled = _make_resident_steps(model, cfg, arrays)
+    return make_scheduled(idx_table)
+
+
+def _make_resident_steps(model: NerrfNet, cfg: TrainConfig, arrays):
+    """One factory for both resident flavors, sharing placement, the gather,
+    and the step body (so fixes to any of them apply to both)."""
     loss_fn = make_loss_fn(model, cfg)
     dev = {k: jax.device_put(v) for k, v in arrays.items()}
 
-    @partial(jax.jit, donate_argnums=(0,))
-    def train_step(state: train_state.TrainState, idx, rng, data):
+    def gathered_step(state, idx, rng, data):
         batch = {k: jnp.take(v, idx, axis=0) for k, v in data.items()}
         return _step_body(loss_fn, state, batch, rng)
 
-    def step(state, idx, rng):
-        return train_step(state, idx, rng, dev)
+    @partial(jax.jit, donate_argnums=(0,))
+    def step_by_idx(state: train_state.TrainState, idx, rng, data):
+        return gathered_step(state, idx, rng, data)
 
-    return step
+    @partial(jax.jit, donate_argnums=(0,))
+    def step_by_schedule(state: train_state.TrainState, rng, data, sched):
+        idx = jnp.take(sched, state.step % sched.shape[0], axis=0)
+        return gathered_step(state, idx, rng, data)
+
+    def resident(state, idx, rng):
+        return step_by_idx(state, idx, rng, dev)
+
+    def make_scheduled(idx_table):
+        table = jax.device_put(np.asarray(idx_table, np.int32))
+        return lambda state, rng: step_by_schedule(state, rng, dev, table)
+
+    return resident, make_scheduled
+
+
+def make_idx_schedule(n: int, cfg: TrainConfig) -> np.ndarray:
+    """The deterministic batch schedule train_nerrfnet follows: row `step` is
+    the same draw the streaming loop would make at that step."""
+    order = np.random.default_rng(cfg.seed)
+    size = min(cfg.batch_size, n)
+    return np.stack([
+        order.choice(n, size=size, replace=False)
+        for _ in range(cfg.num_steps)
+    ])
 
 
 # Datasets larger than this stream batches from host instead of living in
@@ -230,24 +272,26 @@ def train_nerrfnet(
     rng = jax.random.PRNGKey(cfg.seed)
     rng, init_rng = jax.random.split(rng)
     state = init_state(model, cfg, train_ds.arrays, init_rng)
-    # HBM-resident fast path when the dataset fits; stream batches otherwise
+    n = len(train_ds)
+    # HBM-resident + device-scheduled fast path when the dataset fits;
+    # stream batches from host otherwise
     resident = _fits_resident(train_ds.arrays)
     if resident:
-        train_step = make_train_step_resident(model, cfg, train_ds.arrays)
+        train_step = make_train_step_scheduled(
+            model, cfg, train_ds.arrays, make_idx_schedule(n, cfg))
     else:
         train_step = make_train_step(model, cfg)
     eval_fn = make_eval_fn(model)
 
-    n = len(train_ds)
     order_rng = np.random.default_rng(cfg.seed)
     history = []
     # warmup/compile step excluded from timing
     t_start = None
     for step in range(cfg.num_steps):
-        idx = order_rng.choice(n, size=min(cfg.batch_size, n), replace=False)
         if resident:
-            state, loss, aux, rng = train_step(state, jnp.asarray(idx), rng)
+            state, loss, aux, rng = train_step(state, rng)
         else:
+            idx = order_rng.choice(n, size=min(cfg.batch_size, n), replace=False)
             batch = {k: jnp.asarray(v[idx]) for k, v in train_ds.arrays.items()}
             state, loss, aux, rng = train_step(state, batch, rng)
         if step == 0:

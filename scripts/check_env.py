@@ -10,7 +10,10 @@ and prints one line per requirement plus a machine-readable JSON summary.
 
 Exit code 0 iff every REQUIRED row passes.
 
-Usage: python scripts/check_env.py [--json]
+Check-only by default (native rows verify existing build artifacts); pass
+``--build`` to compile the native libraries first.
+
+Usage: python scripts/check_env.py [--json] [--build]
 """
 
 from __future__ import annotations
@@ -62,20 +65,41 @@ def _toolchain(tool):
     return fn
 
 
+_BUILD = "--build" in sys.argv
+
+_NATIVE_LIBS = ("libnerrf_ingest.so", "libnerrf_tracestore.so",
+                "libnerrf_fcdriver.so")
+
+
 def _native_libs():
-    out = subprocess.run(["make", "-s", "all"], cwd=os.path.join(REPO, "native"),
-                         capture_output=True, text=True, timeout=180)
-    if out.returncode != 0:
-        raise RuntimeError(out.stderr.strip()[-200:])
-    libs = sorted(os.listdir(os.path.join(REPO, "native", "build")))
-    return ", ".join(l for l in libs if l.endswith(".so"))
+    """Check-only by default; --build compiles first (the rest of the repo
+    also builds these on demand at first import)."""
+    if _BUILD:
+        out = subprocess.run(["make", "-s", "all"],
+                             cwd=os.path.join(REPO, "native"),
+                             capture_output=True, text=True, timeout=180)
+        if out.returncode != 0:
+            raise RuntimeError(out.stderr.strip()[-200:])
+    build = os.path.join(REPO, "native", "build")
+    missing = [l for l in _NATIVE_LIBS
+               if not os.path.exists(os.path.join(build, l))]
+    if missing:
+        raise FileNotFoundError(
+            f"{', '.join(missing)} (run `make -C native` or pass --build)")
+    return ", ".join(_NATIVE_LIBS)
 
 
 def _bpf_target():
-    out = subprocess.run(["make", "-s", "bpf"], cwd=os.path.join(REPO, "native"),
-                         capture_output=True, text=True, timeout=120)
-    if out.returncode != 0:
-        raise RuntimeError("clang BPF target unavailable (host capture only)")
+    if _BUILD:
+        out = subprocess.run(["make", "-s", "bpf"],
+                             cwd=os.path.join(REPO, "native"),
+                             capture_output=True, text=True, timeout=120)
+        if out.returncode != 0:
+            raise RuntimeError("clang BPF target unavailable (host capture only)")
+    path = os.path.join(REPO, "native", "build", "tracepoints.o")
+    if not os.path.exists(path):
+        raise FileNotFoundError(
+            "tracepoints.o not built (needs clang; `make -C native bpf`)")
     return "tracepoints.o"
 
 

@@ -45,6 +45,20 @@ def test_ring_attention_no_mesh_is_local():
     )
 
 
+@pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.parametrize("t", [1100, 2048])
+def test_blockwise_local_matches_dense(causal, t):
+    """The flash-style local path (scan over key blocks, incl. ragged final
+    block) is exact — identical to materialized attention."""
+    from nerrf_tpu.parallel.ring import _attention_dense
+
+    q, k, v = _qkv(b=1, t=t, h=2, d=8, seed=3)
+    want = _attention_dense(q, k, v, causal)
+    got = _attention_local(q, k, v, causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
 def test_streamnet_sharded_forward_matches_unsharded(mesh):
     trace = simulate_trace(SimConfig(num_target_files=5, duration_sec=40.0, seed=3))
     sb = build_stream(trace, max_len=128)
