@@ -112,6 +112,37 @@ def main() -> None:
         proc_scores=np.array([0.95] + [0.1] * (P - 1), np.float32),
         max_steps=64,
     )
+    # --- long-context leg: StreamNet over raw 4096-event streams ------------
+    stream_events_per_sec = None
+    try:
+        from nerrf_tpu.data import build_streams
+        from nerrf_tpu.models import StreamConfig, StreamNet
+        from nerrf_tpu.parallel import MeshConfig, make_mesh, make_stream_train_step
+
+        mesh1 = make_mesh(MeshConfig(dp=1, tp=1, sp=1), devices=jax.devices()[:1])
+        sb = build_streams(corpus[:6], max_len=4096)
+        smodel = StreamNet(StreamConfig(), mesh=mesh1)
+        init_fn, step_fn, place = make_stream_train_step(smodel, mesh1)
+        with mesh1:
+            placed = place(sb.arrays())
+            sstate = init_fn(jax.random.PRNGKey(2), placed)
+            sstate, sloss, srng = step_fn(sstate, placed, jax.random.PRNGKey(3))
+            jax.block_until_ready(sloss)
+            t0 = time.perf_counter()
+            s_steps = 50
+            for _ in range(s_steps):
+                sstate, sloss, srng = step_fn(sstate, placed, srng)
+            jax.block_until_ready(sloss)
+            dt = time.perf_counter() - t0
+        ev = placed["feat"].shape[0] * placed["feat"].shape[1]
+        stream_events_per_sec = ev * s_steps / dt
+        log(f"[bench] stream: {placed['feat'].shape[0]}x{placed['feat'].shape[1]} "
+            f"events/step, {s_steps / dt:.0f} steps/s → "
+            f"{stream_events_per_sec / 1e6:.1f}M events/s "
+            f"(loss {float(sloss):.4f})")
+    except Exception as e:
+        log(f"[bench] stream leg failed: {e!r}")
+
     rollouts_per_sec = None
     try:  # planner leg must never sink the bench's training metrics
         vnet = ValueNet.create()
@@ -151,6 +182,8 @@ def main() -> None:
         "seq_f1": round(metrics["seq_f1"], 4),
         "mcts_rollouts_per_sec":
             round(rollouts_per_sec, 1) if rollouts_per_sec else None,
+        "stream_events_per_sec":
+            round(stream_events_per_sec) if stream_events_per_sec else None,
         "torch_cpu_steps_per_sec": round(torch_sps, 3) if torch_sps else None,
         "wall_seconds": round(time.perf_counter() - t_wall, 1),
     }))

@@ -20,9 +20,21 @@ import numpy as np
 from flax.training import train_state
 from jax.sharding import Mesh
 
+from typing import TYPE_CHECKING
+
 from nerrf_tpu.models.joint import NerrfNet
 from nerrf_tpu.parallel.mesh import batch_sharding, param_sharding, replicated
-from nerrf_tpu.train.loop import TrainConfig, make_loss_fn, make_tx, model_inputs
+
+if TYPE_CHECKING:  # runtime import is deferred: models → parallel → train.loop
+    from nerrf_tpu.train.loop import TrainConfig                    # noqa: F401
+
+
+def _loop():
+    """nerrf_tpu.train.loop, imported lazily to break the package cycle
+    (train.__init__ → loop → models → stream → parallel → here)."""
+    from nerrf_tpu.train import loop
+
+    return loop
 
 
 def shard_batch(mesh: Mesh, batch: Dict[str, np.ndarray]) -> Dict[str, jax.Array]:
@@ -33,18 +45,19 @@ def shard_batch(mesh: Mesh, batch: Dict[str, np.ndarray]) -> Dict[str, jax.Array
 
 def init_sharded_state(
     model: NerrfNet,
-    cfg: TrainConfig,
+    cfg: "TrainConfig",
     sample: Dict[str, np.ndarray],
     mesh: Mesh,
     rng: Optional[jax.Array] = None,
 ) -> train_state.TrainState:
     """Initialize params directly into their sharded layout (jitted init with
     output shardings, so no host-side full copy materializes first)."""
+    loop = _loop()
     rng = rng if rng is not None else jax.random.PRNGKey(cfg.seed)
     one = {k: jnp.asarray(v[0]) for k, v in sample.items()}
 
     def init_fn(rng):
-        return model.init(rng, *model_inputs(one), deterministic=True)["params"]
+        return model.init(rng, *loop.model_inputs(one), deterministic=True)["params"]
 
     shapes = jax.eval_shape(init_fn, rng)
     p_shard = param_sharding(mesh, shapes)
@@ -52,14 +65,14 @@ def init_sharded_state(
 
     with mesh:
         state = train_state.TrainState.create(
-            apply_fn=model.apply, params=params, tx=make_tx(cfg)
+            apply_fn=model.apply, params=params, tx=loop.make_tx(cfg)
         )
     return state
 
 
-def make_sharded_train_step(model: NerrfNet, cfg: TrainConfig, mesh: Mesh):
+def make_sharded_train_step(model: NerrfNet, cfg: "TrainConfig", mesh: Mesh):
     """Jitted train step with explicit in/out shardings over the mesh."""
-    loss_fn = make_loss_fn(model, cfg)
+    loss_fn = _loop().make_loss_fn(model, cfg)
     b_shard = batch_sharding(mesh)
     r_shard = replicated(mesh)
 

@@ -1,0 +1,146 @@
+"""Experiment runner: one command from a named config to trained artifacts.
+
+The reference's planned entry point was ``ai/train.py`` (`/root/reference/
+README.md:72-76`, never written).  This is ours, driven entirely by the
+experiment registry (BASELINE.json's configs — see nerrf_tpu/config.py):
+
+    python -m nerrf_tpu.train.run --experiment toy-graphsage --out /tmp/run
+    python -m nerrf_tpu.train.run --experiment joint-100h    --out ...
+    python -m nerrf_tpu.train.run --experiment multihost-online --out ...
+        # dp×tp sharded training over all visible devices
+
+Produces under --out: the experiment config as run, a model checkpoint
+(self-describing, loadable by `nerrf undo --model-dir`), and metrics.json
+with the quality gates evaluated on the held-out split.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+
+def _log(msg: str) -> None:
+    print(f"[run] {msg}", file=sys.stderr, flush=True)
+
+
+def run_experiment(name_or_path: str, out_dir: str | Path,
+                   num_steps: int | None = None,
+                   ckpt_every: int = 0, sharded: bool | None = None) -> dict:
+    import dataclasses
+
+    import jax
+
+    from nerrf_tpu.config import get_experiment
+    from nerrf_tpu.train import build_dataset
+    from nerrf_tpu.train.checkpoint import save_checkpoint
+
+    exp = get_experiment(name_or_path)
+    cfg = exp.train
+    if num_steps is not None:
+        cfg = dataclasses.replace(cfg, num_steps=num_steps)
+    out = Path(out_dir)
+    out.mkdir(parents=True, exist_ok=True)
+    exp.save(out / "experiment.json")
+
+    t0 = time.time()
+    _log(f"experiment {exp.name}: building corpus "
+         f"({exp.corpus.num_traces} traces × {exp.corpus.duration_sec:.0f}s)")
+    train_traces, eval_traces = exp.build_corpus()
+    train_ds = build_dataset(train_traces, exp.dataset)
+    eval_ds = build_dataset(eval_traces, exp.dataset) if eval_traces else None
+    _log(f"dataset: {len(train_ds)} train windows"
+         + (f" / {len(eval_ds)} eval" if eval_ds else ""))
+
+    n_dev = len(jax.devices())
+    want_sharded = (exp.mesh.tp * exp.mesh.sp > 1 or
+                    (exp.mesh.dp not in (1, -1))) if sharded is None else sharded
+    if want_sharded and n_dev > 1:
+        from nerrf_tpu.models import NerrfNet
+        from nerrf_tpu.parallel import (
+            init_sharded_state,
+            make_mesh,
+            make_sharded_train_step,
+            shard_batch,
+        )
+
+        _log(f"sharded training over {n_dev} devices (mesh {exp.mesh})")
+        mesh = make_mesh(exp.mesh)
+        model = NerrfNet(cfg.model)
+        state = init_sharded_state(model, cfg, train_ds.arrays, mesh)
+        step = make_sharded_train_step(model, cfg, mesh)
+        import numpy as np
+
+        rng = jax.random.PRNGKey(cfg.seed)
+        order = np.random.default_rng(cfg.seed)
+        b = max(cfg.batch_size, n_dev)
+        t_start = None
+        for i in range(cfg.num_steps):
+            idx = order.choice(len(train_ds), size=b, replace=len(train_ds) < b)
+            batch = shard_batch(mesh, {k: v[idx] for k, v in train_ds.arrays.items()})
+            state, loss, aux, rng = step(state, batch, rng)
+            if i == 0:
+                jax.block_until_ready(loss)
+                t_start = time.perf_counter()
+        jax.block_until_ready(state.params)
+        steps_per_sec = (cfg.num_steps - 1) / max(
+            time.perf_counter() - (t_start or 0), 1e-9)
+        from nerrf_tpu.train.loop import evaluate, make_eval_fn
+
+        metrics = evaluate(make_eval_fn(model), state.params,
+                           eval_ds or train_ds, cfg.batch_size)
+        params = state.params
+    elif ckpt_every > 0:
+        from nerrf_tpu.train.elastic import train_elastic
+
+        res = train_elastic(train_ds, eval_ds, cfg,
+                            ckpt_dir=out / "train_state",
+                            save_every=ckpt_every, log=_log)
+        metrics, steps_per_sec, params = (
+            res.metrics, res.steps_per_sec, res.state.params)
+    else:
+        from nerrf_tpu.train.loop import train_nerrfnet
+
+        res = train_nerrfnet(train_ds, eval_ds, cfg, log=_log)
+        metrics, steps_per_sec, params = (
+            res.metrics, res.steps_per_sec, res.state.params)
+
+    save_checkpoint(out / "model", params, cfg.model)
+    report = {
+        "experiment": exp.name,
+        "backend": jax.default_backend(),
+        "devices": n_dev,
+        "num_steps": cfg.num_steps,
+        "steps_per_sec": round(steps_per_sec, 3),
+        "metrics": {k: round(float(v), 4) for k, v in metrics.items()},
+        "gates": {
+            "edge_auc>=0.90": bool(metrics.get("edge_auc", 0) >= 0.90),
+            "seq_f1>=0.95": bool(metrics.get("seq_f1", 0) >= 0.95),
+        },
+        "wall_seconds": round(time.time() - t0, 1),
+    }
+    (out / "metrics.json").write_text(json.dumps(report, indent=2) + "\n")
+    _log(f"done: {report['metrics']} at {steps_per_sec:.1f} steps/s")
+    return report
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="nerrf_tpu.train.run", description=__doc__)
+    ap.add_argument("--experiment", required=True,
+                    help="registry name or experiment JSON path")
+    ap.add_argument("--out", required=True)
+    ap.add_argument("--steps", type=int, default=None,
+                    help="override the experiment's num_steps")
+    ap.add_argument("--ckpt-every", type=int, default=0,
+                    help="elastic full-state checkpoints every N steps")
+    args = ap.parse_args(argv)
+    report = run_experiment(args.experiment, args.out, args.steps,
+                            args.ckpt_every)
+    return 0 if all(report["gates"].values()) else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

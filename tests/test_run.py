@@ -1,0 +1,30 @@
+"""Experiment runner: named config → corpus → train → checkpoint → report."""
+
+import json
+
+import pytest
+
+from nerrf_tpu.train.run import run_experiment
+
+
+@pytest.mark.slow
+def test_run_toy_experiment_produces_artifacts(tmp_path):
+    report = run_experiment("toy-graphsage", tmp_path, num_steps=60)
+    assert (tmp_path / "experiment.json").exists()
+    assert (tmp_path / "model" / "model_config.json").exists()
+    on_disk = json.loads((tmp_path / "metrics.json").read_text())
+    assert on_disk["experiment"] == "toy-graphsage"
+    assert report["metrics"]["edge_auc"] > 0.5
+    # checkpoint round-trips into the undo path's loader
+    from nerrf_tpu.train.checkpoint import load_checkpoint
+
+    params, cfg = load_checkpoint(tmp_path / "model")
+    assert cfg.gnn.num_layers == 8  # toy experiment's model size
+
+
+@pytest.mark.slow
+def test_run_sharded_experiment_on_virtual_mesh(tmp_path):
+    """multihost-online runs dp×tp sharded on the 8-device virtual mesh."""
+    report = run_experiment("multihost-online", tmp_path, num_steps=4)
+    assert report["devices"] == 8
+    assert report["steps_per_sec"] > 0
