@@ -37,6 +37,10 @@ class StreamConfig:
     mlp_mult: int = 4
     dropout: float = 0.1
     dtype: Any = jnp.bfloat16
+    # rematerialize each transformer block in the backward pass: activation
+    # memory becomes O(num_layers · B·T·dim) params-side only, which is what
+    # lets whole-trace streams train on one chip's HBM
+    remat: bool = True
 
 
 class _Block(nn.Module):
@@ -44,7 +48,8 @@ class _Block(nn.Module):
     mesh: Optional[Mesh] = None
 
     @nn.compact
-    def __call__(self, x, *, deterministic: bool):
+    def __call__(self, x, deterministic: bool):
+        # `deterministic` is positional so nn.remat can mark it static
         cfg = self.cfg
         h, d = cfg.num_heads, cfg.dim // cfg.num_heads
         dt = cfg.dtype
@@ -94,9 +99,10 @@ class StreamNet(nn.Module):
         dt = cfg.dtype
         x = nn.Dense(cfg.dim, dtype=dt, name="embed")(feat.astype(dt))
         x = nn.gelu(x)
+        block_cls = nn.remat(_Block, static_argnums=(2,)) if cfg.remat else _Block
         for i in range(cfg.num_layers):
-            x = _Block(cfg, self.mesh, name=f"block_{i}")(
-                x, deterministic=deterministic
+            x = block_cls(cfg, self.mesh, name=f"block_{i}")(
+                x, deterministic
             )
         x = nn.LayerNorm(dtype=dt, name="final_ln")(x)
         logits = nn.Dense(1, dtype=jnp.float32, name="head")(x)[..., 0]

@@ -99,7 +99,15 @@ def _attention_local(q, k, v, causal: bool) -> jnp.ndarray:
             preferred_element_type=jnp.float32)
         return (o, m_new, l, j + 1), None
 
-    (o, m, l, _), _ = jax.lax.scan(step, (o0, m0, l0, 0), (k_blocks, v_blocks))
+    # Remat the block step: without it, reverse-mode saves `scores`/`pexp`
+    # ([B,H,T,block] f32) for every block of every layer — at bench shapes
+    # (12×4096, 8 blocks, 4 layers) that is ~13 GB of residuals and OOMs a
+    # v5e chip (BENCH_r01 stream leg failure).  Checkpointing recomputes the
+    # two block matmuls in the backward pass; only the O(T·D) carries are
+    # stored, so activation memory is flash-style in both directions.
+    (o, m, l, _), _ = jax.lax.scan(
+        jax.checkpoint(step, prevent_cse=False), (o0, m0, l0, 0),
+        (k_blocks, v_blocks))
     out = o / jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
     return out.astype(q.dtype)
 
@@ -141,7 +149,11 @@ def _ring_shard(q, k, v, *, axis_name: str, manual_axes: tuple, causal: bool) ->
         k_blk, v_blk = jax.lax.ppermute((k_blk, v_blk), axis_name, perm)
         return o, m_new, l, k_blk, v_blk
 
-    o, m, l, _, _ = jax.lax.fori_loop(0, p, step, (o0, m0, l0, k, v))
+    # same residual blow-up as the local path: remat each ring step so the
+    # backward pass recomputes scores instead of storing one [B,H,C,C] f32
+    # tensor per ring hop per layer
+    o, m, l, _, _ = jax.lax.fori_loop(
+        0, p, jax.checkpoint(step, prevent_cse=False), (o0, m0, l0, k, v))
     out = o / jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
     return out.astype(q.dtype)
 
