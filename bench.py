@@ -92,6 +92,16 @@ def main() -> None:
     log(f"[bench] {timed_steps} steps in {elapsed:.1f}s → {steps_per_sec:.2f} steps/s "
         f"(final loss {float(loss):.4f})")
 
+    # --- MFU: XLA-counted FLOPs of the compiled step × steps/s vs chip peak
+    from nerrf_tpu.bench.mfu import flops_per_step, mfu
+
+    step_flops = flops_per_step(train_step, state, rng)
+    achieved_tflops, mfu_pct = mfu(step_flops, steps_per_sec, jax.devices()[0])
+    if step_flops:
+        log(f"[bench] flops/step={step_flops:.3g} → "
+            f"{achieved_tflops:.1f} TFLOP/s"
+            + (f" ({mfu_pct:.1f}% MFU)" if mfu_pct else ""))
+
     # --- quality gate on held-out traces ------------------------------------
     metrics = evaluate(make_eval_fn(model), state.params, eval_ds, cfg.batch_size)
     log(f"[bench] eval: edge_auc={metrics['edge_auc']:.4f} "
@@ -177,7 +187,13 @@ def main() -> None:
         "value": round(steps_per_sec, 3),
         "unit": "steps/s (batch=8 windows, 256n/512e/128seq)",
         "vs_baseline": round(vs_baseline, 2) if vs_baseline else None,
+        "vs_baseline_note": "same-arch torch on this host's CPU (no CUDA in "
+                            "env; chip-side metric of record is mfu_pct)",
         "backend": backend,
+        "model_flops_per_step": round(step_flops) if step_flops else None,
+        "achieved_tflops":
+            round(achieved_tflops, 2) if achieved_tflops else None,
+        "mfu_pct": round(mfu_pct, 2) if mfu_pct else None,
         "edge_roc_auc": round(metrics["edge_auc"], 4),
         "seq_f1": round(metrics["seq_f1"], 4),
         "mcts_rollouts_per_sec":

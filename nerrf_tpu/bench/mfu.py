@@ -1,0 +1,62 @@
+"""Model-FLOPs-utilization accounting for the benchmark of record.
+
+The reference has no chip-side perf baseline (its AI subsystem was never
+built, SURVEY.md §6), and a torch-on-CPU ratio is a strawman — the honest
+single-chip metric is MFU: XLA-counted FLOPs per step × steps/s over the
+chip's peak.  `flops_per_step` asks the compiled executable itself
+(`compiled.cost_analysis()`), so the number tracks the real HLO after
+fusion/remat, not a hand model.  Note XLA counts rematerialized FLOPs too,
+so MFU here is *hardware* utilization (includes recompute), the same
+convention as the scaling-book's "hardware FLOPs utilization".
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+# bf16 peak TFLOP/s per chip, by device_kind substring (public specs).
+_PEAKS = (
+    ("v6 lite", 918.0),  # Trillium / v6e
+    ("v6e", 918.0),
+    ("v5 lite", 197.0),  # v5e
+    ("v5e", 197.0),
+    ("v5p", 459.0),
+    ("v5", 197.0),
+    ("v4", 275.0),
+    ("v3", 123.0),
+    ("v2", 45.0),
+)
+
+
+def chip_peak_tflops(device) -> Optional[float]:
+    """bf16 peak for a jax device, or None when unknown (e.g. CPU)."""
+    kind = getattr(device, "device_kind", "").lower()
+    if "tpu" not in kind and getattr(device, "platform", "") != "tpu":
+        return None
+    for pat, peak in _PEAKS:
+        if pat in kind:
+            return peak
+    return None
+
+
+def flops_per_step(jit_fn, *args, **kwargs) -> Optional[float]:
+    """FLOPs of one call of a jitted function, from XLA cost analysis."""
+    try:
+        compiled = jit_fn.lower(*args, **kwargs).compile()
+        cost = compiled.cost_analysis()
+        if isinstance(cost, (list, tuple)):  # older jax returns [dict]
+            cost = cost[0]
+        f = float(cost.get("flops", 0.0))
+        return f if f > 0 else None
+    except Exception:
+        return None
+
+
+def mfu(flops: Optional[float], steps_per_sec: float,
+        device) -> tuple[Optional[float], Optional[float]]:
+    """(achieved_tflops, mfu_pct) — None when flops or peak are unknown."""
+    if not flops:
+        return None, None
+    achieved = flops * steps_per_sec / 1e12
+    peak = chip_peak_tflops(device)
+    return achieved, (100.0 * achieved / peak if peak else None)

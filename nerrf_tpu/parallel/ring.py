@@ -51,9 +51,13 @@ _LOCAL_BLOCK = 512
 
 
 def _attention_local(q, k, v, causal: bool) -> jnp.ndarray:
-    """Exact single-device attention, blockwise (flash-style): scan over key
-    blocks with an online-softmax accumulator, so peak memory is
-    O(T·block) — never the [B, H, T, T] score tensor, which at 6×4096
+    """Exact single-device attention, blockwise (flash-style).
+
+    Queries are processed one block at a time; each query block scans only
+    the key blocks its causal mask can reach (0..i), so no FLOPs are spent
+    on fully-masked future blocks — at T=4096 that halves attention compute
+    vs the naive all-blocks scan.  Online-softmax accumulation keeps peak
+    memory O(block²) — never the [B, H, T, T] score tensor, which at bench
     stream shapes is gigabytes of HBM traffic per layer.  Matmuls run in the
     input dtype (bf16 on TPU → MXU rate); accumulation is float32."""
     b, t, h, d = q.shape
@@ -64,51 +68,79 @@ def _attention_local(q, k, v, causal: bool) -> jnp.ndarray:
     block = _LOCAL_BLOCK
     pad = (-t) % block
     if pad:
+        q = jnp.pad(q, ((0, 0), (0, pad), (0, 0), (0, 0)))
         k = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
         v = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
-    nb = k.shape[1] // block
+    tp = q.shape[1]
+    nb = tp // block
     scale = d ** -0.5
-    q_pos = jax.lax.broadcasted_iota(jnp.int32, (t, 1), 0)
 
     k_blocks = k.reshape(b, nb, block, h, d).transpose(1, 0, 2, 3, 4)
     v_blocks = v.reshape(b, nb, block, h, d).transpose(1, 0, 2, 3, 4)
+    in_pos = jax.lax.broadcasted_iota(jnp.int32, (1, block), 1)
 
-    o0 = jnp.zeros((b, t, h, d), jnp.float32)
-    m0 = jnp.full((b, h, t), -1e9, jnp.float32)
-    l0 = jnp.zeros((b, h, t), jnp.float32)
-
-    def step(carry, blk):
-        o, m, l, j = carry
+    def block_step(q_blk, q_pos, carry, blk, masked):
+        """One (q-block, k-block) flash update.  masked=True applies the
+        intra-block causal triangle + key-padding mask (diagonal block);
+        off-diagonal blocks below the diagonal need no mask at all."""
+        o, m, l, k_pos0 = carry
         k_blk, v_blk = blk
-        # bf16 operands on the MXU, f32 accumulation; the mask constant stays
-        # far inside range (bf16 cotangents through ±1e30 NaN on TPU)
         scores = jnp.einsum(
-            "bqhd,bkhd->bhqk", q, k_blk,
+            "bqhd,bkhd->bhqk", q_blk, k_blk,
             preferred_element_type=jnp.float32) * scale
-        k_pos = j * block + jax.lax.broadcasted_iota(jnp.int32, (1, block), 1)
-        valid = k_pos < t  # padded key tail
-        if causal:
-            valid = valid & (k_pos <= q_pos)
-        scores = jnp.where(valid[None, None], scores, -1e9)
+        if masked:
+            k_pos = k_pos0 + in_pos
+            valid = k_pos < t
+            if causal:
+                valid = valid & (k_pos <= q_pos)
+            # -1e9 stays far inside bf16 range (±1e30 NaNs bf16 cotangents)
+            scores = jnp.where(valid[None, None], scores, -1e9)
         m_new = jnp.maximum(m, scores.max(axis=-1))
         pexp = jnp.exp(scores - m_new[..., None])
         alpha = jnp.exp(m - m_new)
         l = alpha * l + pexp.sum(axis=-1)
+        # second matmul in compute dtype too: pexp ∈ [0,1] is safe in bf16,
+        # and an f32×bf16 einsum would fall off the MXU fast path
         o = alpha.transpose(0, 2, 1)[..., None] * o + jnp.einsum(
-            "bhqk,bkhd->bqhd", pexp, v_blk,
+            "bhqk,bkhd->bqhd", pexp.astype(q.dtype), v_blk,
             preferred_element_type=jnp.float32)
-        return (o, m_new, l, j + 1), None
+        return o, m_new, l, k_pos0 + block
 
-    # Remat the block step: without it, reverse-mode saves `scores`/`pexp`
-    # ([B,H,T,block] f32) for every block of every layer — at bench shapes
-    # (12×4096, 8 blocks, 4 layers) that is ~13 GB of residuals and OOMs a
-    # v5e chip (BENCH_r01 stream leg failure).  Checkpointing recomputes the
-    # two block matmuls in the backward pass; only the O(T·D) carries are
-    # stored, so activation memory is flash-style in both directions.
-    (o, m, l, _), _ = jax.lax.scan(
-        jax.checkpoint(step, prevent_cse=False), (o0, m0, l0, 0),
-        (k_blocks, v_blocks))
-    out = o / jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
+    # Remat each block update: without it, reverse-mode saves scores/pexp
+    # ([B,H,block,block] f32) for every block pair of every layer — at bench
+    # shapes that is ~13 GB of residuals and OOMs a v5e chip (BENCH_r01
+    # stream leg failure).  Checkpointing recomputes the two block matmuls
+    # in the backward pass; only the O(block·D) carries are stored.
+    remat_step = jax.checkpoint(
+        lambda qb, qp, c, blk: block_step(qb, qp, c, blk, False),
+        prevent_cse=False)
+    remat_diag = jax.checkpoint(
+        lambda qb, qp, c, blk: block_step(qb, qp, c, blk, True),
+        prevent_cse=False)
+
+    outs = []
+    for i in range(nb):
+        q_blk = q[:, i * block:(i + 1) * block]
+        q_pos = i * block + jax.lax.broadcasted_iota(jnp.int32, (block, 1), 0)
+        o0 = jnp.zeros((b, block, h, d), jnp.float32)
+        m0 = jnp.full((b, h, block), -1e9, jnp.float32)
+        l0 = jnp.zeros((b, h, block), jnp.float32)
+        carry = (o0, m0, l0, 0)
+        n_full = i if causal else 0
+        if n_full:
+            carry = jax.lax.scan(
+                lambda c, blk: (remat_step(q_blk, q_pos, c, blk), None),
+                carry, (k_blocks[:n_full], v_blocks[:n_full]))[0]
+        lo = n_full
+        hi = i + 1 if causal else nb
+        for j in range(lo, hi):
+            carry = remat_diag(q_blk, q_pos, carry,
+                               (k_blocks[j], v_blocks[j]))
+        o, m, l, _ = carry
+        outs.append(o / jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None])
+    out = jnp.concatenate(outs, axis=1)
+    if pad:
+        out = out[:, :t]
     return out.astype(q.dtype)
 
 

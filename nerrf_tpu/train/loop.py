@@ -17,6 +17,7 @@ from __future__ import annotations
 import dataclasses
 import time
 from functools import partial
+from pathlib import Path
 from typing import Any, Dict, Optional
 
 import jax
@@ -163,7 +164,10 @@ def _make_resident_steps(model: NerrfNet, cfg: TrainConfig, arrays):
 
     def make_scheduled(idx_table):
         table = jax.device_put(np.asarray(idx_table, np.int32))
-        return lambda state, rng: step_by_schedule(state, rng, dev, table)
+        fn = lambda state, rng: step_by_schedule(state, rng, dev, table)
+        # expose AOT lowering so the bench can cost-analyze the real HLO
+        fn.lower = lambda state, rng: step_by_schedule.lower(state, rng, dev, table)
+        return fn
 
     return resident, make_scheduled
 
@@ -318,3 +322,165 @@ def train_nerrfnet(
     )
     return TrainResult(state=state, metrics=metrics, steps_per_sec=steps_per_sec,
                        history=history)
+
+
+def train_sharded_stream(
+    corpus,
+    cfg: Optional[TrainConfig] = None,
+    eval_ds: Optional[WindowDataset] = None,
+    log=None,
+    passes_per_shard: int = 2,
+    ckpt_dir=None,
+    save_every: int = 0,
+) -> TrainResult:
+    """100 h-scale training: rotate disk shards through HBM, double-buffered.
+
+    The full corpus (~16 GB of window tensors at 100 h — train/corpus.py)
+    exceeds HBM, and per-batch host→device streaming is throttled by the
+    ~0.5 GB/s transfer link, so neither resident nor per-step streaming
+    works.  Instead: a disk-reader thread stages shard i+1 in host RAM
+    while the chip trains on shard i; the consumer issues the (async)
+    device_put for i+1 as soon as it starts computing on i, so the upload
+    hides behind `passes_per_shard` epochs of scheduled batches and HBM
+    never holds more than two shards.  Shard order reshuffles every corpus
+    epoch (block-shuffled SGD).
+
+    ``ckpt_dir``/``save_every`` enable periodic full-state checkpoints and
+    resume-from-latest (elastic.py machinery).  Resume restores params/
+    opt-state/step exactly; the *batch schedule* restarts from the restored
+    step's derived rng, which is deterministic per step but means the
+    shard rotation is not replayed bit-identically across restarts —
+    acceptable for the 100 h run (pure data-order perturbation).
+    """
+    import queue as queue_mod
+    import threading
+
+    cfg = cfg or TrainConfig()
+    model = NerrfNet(cfg.model)
+    loss_fn = make_loss_fn(model, cfg)
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def step_by_idx(state, idx, rng, data):
+        batch = {k: jnp.take(v, idx, axis=0) for k, v in data.items()}
+        # f16 is a storage/transfer format only — compute sees f32
+        batch = {
+            k: v.astype(jnp.float32) if v.dtype == jnp.float16 else v
+            for k, v in batch.items()
+        }
+        return _step_body(loss_fn, state, batch, rng)
+
+    # -- shard pipeline: disk → host queue → async device upload -------------
+    host_q: "queue_mod.Queue" = queue_mod.Queue(maxsize=1)
+    stop = threading.Event()
+
+    def reader():
+        try:
+            epoch = 0
+            while not stop.is_set():
+                for arrays in corpus.iter_train_shards(
+                        epoch_seed=cfg.seed + epoch):
+                    while not stop.is_set():
+                        try:
+                            host_q.put(arrays, timeout=0.5)
+                            break
+                        except queue_mod.Full:
+                            continue
+                    if stop.is_set():
+                        return
+                epoch += 1
+        except BaseException as e:  # propagate instead of hanging the train
+            host_q.put(e)
+
+    thread = threading.Thread(target=reader, daemon=True)
+    thread.start()
+
+    def next_host_shard():
+        while True:
+            try:
+                item = host_q.get(timeout=5.0)
+            except queue_mod.Empty:
+                if not thread.is_alive():
+                    raise RuntimeError(
+                        "corpus reader thread died without reporting")
+                continue
+            if isinstance(item, BaseException):
+                raise RuntimeError("corpus shard read failed") from item
+            return item
+
+    rng = jax.random.PRNGKey(cfg.seed)
+    rng, init_rng = jax.random.split(rng)
+    shard = jax.device_put(next_host_shard())
+    state = init_state(model, cfg, shard, init_rng)
+
+    steps_done = 0
+    if ckpt_dir is not None and save_every > 0:
+        from nerrf_tpu.train.elastic import _restore_full, _save_full, latest_step
+
+        resumed = latest_step(ckpt_dir)
+        if resumed is not None:
+            state = _restore_full(Path(ckpt_dir), resumed, state)
+            steps_done = resumed
+            if log:
+                log(f"resumed from step {resumed}")
+
+    order = np.random.default_rng((cfg.seed, steps_done))
+    history = []
+    t_start = None
+    timed_from = steps_done
+    loss = None
+    try:
+        while steps_done < cfg.num_steps:
+            # stage the next shard: async upload overlaps this shard's steps
+            nxt = jax.device_put(next_host_shard()) \
+                if steps_done + _shard_steps(shard, cfg, passes_per_shard) \
+                < cfg.num_steps else None
+            n = int(shard["node_feat"].shape[0])
+            local = min(_shard_steps(shard, cfg, passes_per_shard),
+                        cfg.num_steps - steps_done)
+            for _ in range(local):
+                idx = jnp.asarray(
+                    order.choice(n, size=min(cfg.batch_size, n),
+                                 replace=False))
+                state, loss, aux, rng = step_by_idx(state, idx, rng, shard)
+                if t_start is None:
+                    jax.block_until_ready(loss)
+                    t_start = time.perf_counter()
+                    timed_from = steps_done
+                if cfg.eval_every and steps_done % cfg.eval_every == 0:
+                    history.append({"step": steps_done, "loss": float(loss)})
+                    if log:
+                        log(f"step {steps_done}: loss={float(loss):.4f} "
+                            + " ".join(f"{k}={float(v):.4f}"
+                                       for k, v in aux.items()))
+                steps_done += 1
+                if (ckpt_dir is not None and save_every > 0
+                        and steps_done % save_every == 0):
+                    _save_full(Path(ckpt_dir), steps_done, state)
+            if nxt is not None:
+                shard = nxt
+    finally:
+        stop.set()
+        try:  # release a blocked put so the reader can exit
+            while True:
+                host_q.get_nowait()
+        except queue_mod.Empty:
+            pass
+        thread.join(timeout=10)
+
+    jax.block_until_ready(state.params)
+    if ckpt_dir is not None and save_every > 0:
+        _save_full(Path(ckpt_dir), steps_done, state)
+    elapsed = time.perf_counter() - (t_start or time.perf_counter())
+    timed = max(steps_done - timed_from - 1, 1)
+    steps_per_sec = timed / elapsed if elapsed > 0 else 0.0
+    metrics = (
+        evaluate(make_eval_fn(model), state.params, eval_ds, cfg.batch_size)
+        if eval_ds is not None else {}
+    )
+    return TrainResult(state=state, metrics=metrics,
+                       steps_per_sec=steps_per_sec, history=history)
+
+
+def _shard_steps(shard, cfg: TrainConfig, passes: int) -> int:
+    n = int(shard["node_feat"].shape[0])
+    return max(1, passes * n // cfg.batch_size)
