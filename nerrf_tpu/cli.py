@@ -253,32 +253,63 @@ def cmd_ingest(args) -> int:
 
     from nerrf_tpu.graph.store import TraceStore
     from nerrf_tpu.ingest.service import TrackerClient
+    from nerrf_tpu.observability import DEFAULT_REGISTRY, MetricsServer
 
+    metrics = None
+    if args.metrics_port >= 0:
+        try:
+            metrics = MetricsServer(host="0.0.0.0", port=args.metrics_port)
+        except OSError:
+            # port taken (another ingest/serve on this host): fall back to an
+            # ephemeral port rather than refusing to ingest at all
+            metrics = MetricsServer(host="0.0.0.0", port=0)
+            _log(f"metrics port {args.metrics_port} in use; using ephemeral")
+        _log(f"metrics on :{metrics.port}")
     total = 0
     segments = 0
-    with TraceStore(args.store_dir, bucket_sec=args.bucket_sec) as st:
-        while True:
-            client = TrackerClient(args.target)
-            try:
-                for events, strings in client.iter_blocks(
-                        max_events=args.max_events or None,
-                        timeout=args.timeout):
-                    total += st.append(events, strings)
+    try:
+        with TraceStore(args.store_dir, bucket_sec=args.bucket_sec) as st:
+            # Durability flush on a wall-clock cadence, not per decoded frame:
+            # every flush rewrites the active bucket's whole segment (delta
+            # compaction), so per-frame flushing is O(rows²) disk traffic.
+            # Memory stays bounded between flushes by the store's own
+            # AUTO_FLUSH_ROWS.  At most --flush-sec of received-but-unflushed
+            # events are lost on a crash (a dropped *stream* still loses
+            # nothing: the finally-flush below runs per connection).
+            last_flush = time.monotonic()
+            while True:
+                client = TrackerClient(args.target)
+                try:
+                    for events, strings in client.iter_blocks(
+                            max_events=args.max_events or None,
+                            timeout=args.timeout):
+                        stored = st.append(events, strings)
+                        total += stored
+                        DEFAULT_REGISTRY.counter_inc(
+                            "ingest_events_stored_total", stored,
+                            help="events appended to the trace store")
+                        now = time.monotonic()
+                        if now - last_flush >= args.flush_sec:
+                            segments += st.flush()
+                            last_flush = now
+                except grpc.RpcError as e:
+                    _log(f"stream ended: {e.code().name}")
+                finally:
                     segments += st.flush()
-            except grpc.RpcError as e:
-                # stream end by deadline/disconnect: everything received is
-                # already flushed
-                _log(f"stream ended: {e.code().name}")
-            if not args.follow:
-                break
-            time.sleep(args.reconnect_sec)
-        out = {
-            "events": total,
-            "segments_written": segments,
-            "segments_live": st.num_segments,
-            "strings": st.num_strings,
-            "engine": "native" if st.is_native else "python",
-        }
+                    last_flush = time.monotonic()
+                if not args.follow:
+                    break
+                time.sleep(args.reconnect_sec)
+            out = {
+                "events": total,
+                "segments_written": segments,
+                "segments_live": st.num_segments,
+                "strings": st.num_strings,
+                "engine": "native" if st.is_native else "python",
+            }
+    finally:
+        if metrics:
+            metrics.close()
     print(json.dumps(out))
     return 0
 
@@ -339,6 +370,12 @@ def main(argv=None) -> int:
     p.add_argument("--follow", action="store_true",
                    help="reconnect and keep draining forever (daemon mode)")
     p.add_argument("--reconnect-sec", type=float, default=2.0)
+    p.add_argument("--flush-sec", type=float, default=5.0,
+                   help="durability flush cadence (seconds)")
+    p.add_argument("--metrics-port", type=int, default=9091,
+                   help="Prometheus /metrics port (-1 disables). Default "
+                        "9091 so serve (9090) + ingest coexist on one host; "
+                        "the K8s ingest pod passes 9090 explicitly")
     p.set_defaults(fn=cmd_ingest)
 
     args = ap.parse_args(argv)

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import ctypes
 import os
+import re
 import struct
 from pathlib import Path
 from typing import Optional, Tuple
@@ -327,11 +328,15 @@ class _PyStore:
                 self._slog.write(struct.pack("<I", len(b)) + b)
 
         stale = []
+        # segment names are "<mn>-<mx>-<seq>.seg" where mn/mx may be negative
+        # (bucket < 0 for pre-epoch ts_ns) — split from the right so leading
+        # minus signs parse, matching the native engine's sscanf
+        seg_re = re.compile(r"^(-?\d+)-(-?\d+)-(\d+)$")
         for p in sorted(self.segdir.glob("*.seg")):
-            try:
-                mn, mx, seq = (int(x) for x in p.stem.split("-"))
-            except ValueError:
+            m = seg_re.match(p.stem)
+            if not m:
                 continue
+            mn, mx, seq = (int(x) for x in m.groups())
             self.next_seq = max(self.next_seq, seq + 1)
             cur = self.segments.get(mn)
             if cur is None or seq > cur[0]:

@@ -15,9 +15,11 @@ Design for determinism under restart:
   * checkpoints hold the full ``TrainState`` (params + optimizer state +
     step) via orbax, written step-dir-atomically: the ``meta.json`` sidecar
     is written last and is the scanner's commit marker;
-  * a heartbeat file updated at every save supports external failure
-    detection (`stale_heartbeat`), the host-side analogue of a missing
-    DaemonSet liveness probe.
+  * a heartbeat file updated every few seconds of training (HEARTBEAT_SEC)
+    and at every save supports external failure detection
+    (`stale_heartbeat`), the host-side analogue of a missing DaemonSet
+    liveness probe; supervisors should use timeout ≫ HEARTBEAT_SEC, not the
+    checkpoint interval.
 
 Fault injection for tests/drills: pass ``fault=Preemption.at(step)`` and the
 loop raises mid-run exactly once, after the step's optimizer update but
@@ -110,6 +112,9 @@ def _restore_full(ckpt_dir: Path, step: int, template_state):
         step=step, params=got["params"], opt_state=got["opt_state"])
 
 
+HEARTBEAT_SEC = 5.0  # wall-clock heartbeat cadence during training
+
+
 def _heartbeat(ckpt_dir: Path, step: int) -> None:
     tmp = ckpt_dir / ".heartbeat.tmp"
     tmp.write_text(json.dumps({"step": step, "ts": time.time()}) + "\n")
@@ -163,6 +168,10 @@ def train_elastic(
     history = []
     t_start = None
     loss = None
+    # Heartbeat on a wall-clock cadence (HEARTBEAT_SEC), decoupled from the
+    # checkpoint interval: keyed only to saves, a supervisor with
+    # timeout < save_every × step-time would restart healthy runs.
+    last_hb = 0.0
     for step in range(start, cfg.num_steps):
         # derived randomness: identical for step N on every (re)run
         order = np.random.default_rng((cfg.seed, step))
@@ -178,6 +187,10 @@ def train_elastic(
             t_start = time.perf_counter()
         if fault is not None:
             fault(step)
+        now = time.monotonic()
+        if now - last_hb >= HEARTBEAT_SEC:
+            _heartbeat(ckpt_dir, step)
+            last_hb = now
         done = step + 1
         if done % save_every == 0 or done == cfg.num_steps:
             _save_full(ckpt_dir, done, state)

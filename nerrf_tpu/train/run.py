@@ -47,6 +47,43 @@ def run_experiment(name_or_path: str, out_dir: str | Path,
     exp.save(out / "experiment.json")
 
     t0 = time.time()
+    corpus_extra = {}
+    n_dev = len(jax.devices())
+
+    # --- disk-sharded corpus path (the true 100 h run) ----------------------
+    if exp.corpus_dir:
+        cdir = Path(exp.corpus_dir)
+        if not cdir.is_absolute():
+            cdir = Path(__file__).resolve().parents[2] / cdir
+        if (cdir / "manifest.json").exists():
+            from nerrf_tpu.train.corpus import ShardedCorpus
+            from nerrf_tpu.train.loop import train_sharded_stream
+
+            sc = ShardedCorpus(cdir)
+            _log(f"experiment {exp.name}: disk corpus {sc.hours:.1f}h, "
+                 f"{sc.train_windows} train windows "
+                 f"({len(sc.train_shards)} shards)")
+            eval_ds = sc.eval_dataset()
+            _log(f"eval split: {len(eval_ds)} held-out-trace windows")
+            res = train_sharded_stream(
+                sc, cfg, eval_ds=eval_ds, log=_log,
+                ckpt_dir=(out / "train_state") if ckpt_every > 0 else None,
+                save_every=ckpt_every)
+            metrics, steps_per_sec, params = (
+                res.metrics, res.steps_per_sec, res.state.params)
+            corpus_extra = {
+                "corpus_hours": round(sc.hours, 2),
+                "corpus_train_windows": sc.train_windows,
+                "corpus_eval_windows": int(sc.manifest["eval_windows"]),
+            }
+            return _finish(exp, cfg, out, n_dev, metrics, steps_per_sec,
+                           params, t0, corpus_extra)
+        _log(f"corpus_dir {cdir} not generated "
+             f"(python scripts/gen_corpus.py --out {cdir}) — falling back "
+             f"to the in-memory corpus "
+             f"({exp.corpus.num_traces}×{exp.corpus.duration_sec:.0f}s = "
+             f"{exp.corpus.num_traces * exp.corpus.duration_sec / 3600:.1f}h)")
+
     _log(f"experiment {exp.name}: building corpus "
          f"({exp.corpus.num_traces} traces × {exp.corpus.duration_sec:.0f}s)")
     train_traces, eval_traces = exp.build_corpus()
@@ -54,8 +91,6 @@ def run_experiment(name_or_path: str, out_dir: str | Path,
     eval_ds = build_dataset(eval_traces, exp.dataset) if eval_traces else None
     _log(f"dataset: {len(train_ds)} train windows"
          + (f" / {len(eval_ds)} eval" if eval_ds else ""))
-
-    n_dev = len(jax.devices())
     want_sharded = (exp.mesh.tp * exp.mesh.sp > 1 or
                     (exp.mesh.dp not in (1, -1))) if sharded is None else sharded
     if want_sharded and n_dev > 1:
@@ -108,6 +143,16 @@ def run_experiment(name_or_path: str, out_dir: str | Path,
         metrics, steps_per_sec, params = (
             res.metrics, res.steps_per_sec, res.state.params)
 
+    return _finish(exp, cfg, out, n_dev, metrics, steps_per_sec, params, t0,
+                   corpus_extra)
+
+
+def _finish(exp, cfg, out: Path, n_dev, metrics, steps_per_sec, params,
+            t0, extra) -> dict:
+    import jax
+
+    from nerrf_tpu.train.checkpoint import save_checkpoint
+
     save_checkpoint(out / "model", params, cfg.model)
     report = {
         "experiment": exp.name,
@@ -116,11 +161,18 @@ def run_experiment(name_or_path: str, out_dir: str | Path,
         "num_steps": cfg.num_steps,
         "steps_per_sec": round(steps_per_sec, 3),
         "metrics": {k: round(float(v), 4) for k, v in metrics.items()},
+        # A head's gate only applies when the experiment trains that head:
+        # lstm-impact runs with edge/node weights 0 and toy-graphsage with
+        # seq weight 0 — an untrained head's gate could never pass and would
+        # fail successful runs of those registry experiments.
         "gates": {
-            "edge_auc>=0.90": bool(metrics.get("edge_auc", 0) >= 0.90),
-            "seq_f1>=0.95": bool(metrics.get("seq_f1", 0) >= 0.95),
+            **({"edge_auc>=0.90": bool(metrics.get("edge_auc", 0) >= 0.90)}
+               if cfg.edge_loss_weight > 0 else {}),
+            **({"seq_f1>=0.95": bool(metrics.get("seq_f1", 0) >= 0.95)}
+               if cfg.seq_loss_weight > 0 else {}),
         },
         "wall_seconds": round(time.time() - t0, 1),
+        **extra,
     }
     (out / "metrics.json").write_text(json.dumps(report, indent=2) + "\n")
     _log(f"done: {report['metrics']} at {steps_per_sec:.1f} steps/s")

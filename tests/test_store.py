@@ -191,3 +191,36 @@ def test_store_feeds_graph_constructor(tmp_path, trace, engine):
             ev, strings, lo, hi, GraphConfig(max_nodes=128, max_edges=256)
         )
         assert stats.num_nodes > 0 and stats.num_edges > 0
+
+
+@pytest.mark.parametrize("writer", ENGINES)
+@pytest.mark.parametrize("reader", ENGINES)
+def test_negative_timestamps_cross_engine(tmp_path, writer, reader):
+    """Pre-epoch ts_ns produce negative bucket names ('-30000000000--1-0.seg');
+    both engines must write AND reopen them identically (the Python parser
+    once split on '-' from the left and silently skipped these on reopen)."""
+    from nerrf_tpu.schema.events import EventArrays, StringTable
+
+    strings = StringTable()
+    recs = [
+        {"ts_ns": -25 * 10**9, "pid": 1, "comm": "a", "syscall": "write",
+         "path": "/x", "bytes": 1},
+        {"ts_ns": -1, "pid": 1, "comm": "a", "syscall": "write",
+         "path": "/y", "bytes": 2},
+        {"ts_ns": 5 * 10**9, "pid": 2, "comm": "b", "syscall": "openat",
+         "path": "/z"},
+    ]
+    ev = EventArrays.from_records(recs, strings)
+    with _open(tmp_path, writer) as st:
+        st.append(ev, strings)
+        st.flush()
+        assert st.query_count(-(10**12), 10**12) == 3
+    with _open(tmp_path, reader) as st:
+        got, gs = st.query(-(10**12), 10**12)
+        assert got.num_valid == 3
+        assert _resolved(got, gs) == _resolved(ev.sort_by_time(), strings)
+        # appending after reopen must compact into, not orphan, the
+        # negative-bucket segments
+        st.append(ev, strings)
+        st.flush()
+        assert st.query_count(-(10**12), 10**12) == 6
