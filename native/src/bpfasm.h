@@ -1,0 +1,85 @@
+// Minimal eBPF assembler: just enough to emit the capture programs without
+// clang.  Instruction encodings follow the kernel ABI (linux/bpf.h); helper
+// ids are the stable UAPI numbers.  The builder is label-free — jumps are
+// emitted with explicit forward offsets patched by the caller — because the
+// programs are short and linear.
+#ifndef NERRF_BPFASM_H_
+#define NERRF_BPFASM_H_
+
+#include <cstdint>
+#include <vector>
+
+namespace nerrf {
+
+struct BpfInsn {
+  uint8_t code;
+  uint8_t dst_src;  // dst | (src << 4)
+  int16_t off;
+  int32_t imm;
+};
+
+// registers
+enum { R0 = 0, R1, R2, R3, R4, R5, R6, R7, R8, R9, R10 };
+
+// helper ids (UAPI, stable)
+enum {
+  HELPER_MAP_LOOKUP_ELEM = 1,
+  HELPER_KTIME_GET_NS = 5,
+  HELPER_GET_CURRENT_PID_TGID = 14,
+  HELPER_GET_CURRENT_COMM = 16,
+  HELPER_PROBE_READ_USER_STR = 114,
+  HELPER_RINGBUF_RESERVE = 131,
+  HELPER_RINGBUF_SUBMIT = 132,
+};
+
+class BpfProg {
+ public:
+  std::vector<BpfInsn> insns;
+
+  int pos() const { return static_cast<int>(insns.size()); }
+
+  void raw(uint8_t code, uint8_t dst, uint8_t src, int16_t off, int32_t imm) {
+    insns.push_back({code, static_cast<uint8_t>(dst | (src << 4)), off, imm});
+  }
+
+  // alu
+  void mov64_imm(int dst, int32_t imm) { raw(0xb7, dst, 0, 0, imm); }
+  void mov64_reg(int dst, int src) { raw(0xbf, dst, src, 0, 0); }
+  void add64_imm(int dst, int32_t imm) { raw(0x07, dst, 0, 0, imm); }
+  void rsh64_imm(int dst, int32_t imm) { raw(0x77, dst, 0, 0, imm); }
+
+  // memory: size codes — DW=0x18, W=0x00, H=0x08, B=0x10 within ldx/stx class
+  void ldx_dw(int dst, int src, int16_t off) { raw(0x79, dst, src, off, 0); }
+  void ldx_w(int dst, int src, int16_t off) { raw(0x61, dst, src, off, 0); }
+  void stx_dw(int dst, int src, int16_t off) { raw(0x7b, dst, src, off, 0); }
+  void stx_w(int dst, int src, int16_t off) { raw(0x63, dst, src, off, 0); }
+  void st_dw(int dst, int16_t off, int32_t imm) { raw(0x7a, dst, 0, off, imm); }
+  void st_w(int dst, int16_t off, int32_t imm) { raw(0x62, dst, 0, off, imm); }
+  void st_b(int dst, int16_t off, int32_t imm) { raw(0x72, dst, 0, off, imm); }
+  // atomic 64-bit add: *(u64*)(dst+off) += src
+  void xadd_dw(int dst, int src, int16_t off) { raw(0xdb, dst, src, off, 0); }
+
+  // jumps (off is relative to the *next* instruction)
+  void ja(int16_t off) { raw(0x05, 0, 0, off, 0); }
+  void jeq_imm(int dst, int32_t imm, int16_t off) { raw(0x15, dst, 0, off, imm); }
+  void jne_imm(int dst, int32_t imm, int16_t off) { raw(0x55, dst, 0, off, imm); }
+  void jeq_reg(int dst, int src, int16_t off) { raw(0x1d, dst, src, off, 0); }
+
+  void call(int32_t helper) { raw(0x85, 0, 0, 0, helper); }
+  void exit() { raw(0x95, 0, 0, 0, 0); }
+
+  // 64-bit immediate load of a map fd (BPF_PSEUDO_MAP_FD in src): 2 insns
+  void ld_map_fd(int dst, int fd) {
+    raw(0x18, dst, 1, 0, fd);
+    raw(0x00, 0, 0, 0, 0);
+  }
+
+  // patch a previously emitted jump to land on the current position
+  void patch_jump(int at) {
+    insns[at].off = static_cast<int16_t>(pos() - at - 1);
+  }
+};
+
+}  // namespace nerrf
+
+#endif  // NERRF_BPFASM_H_

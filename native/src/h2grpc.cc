@@ -1,0 +1,622 @@
+// See h2grpc.h for scope.  Frame/HPACK wire formats per RFC 7540/7541.
+
+#include "h2grpc.h"
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <string.h>
+#include <sys/eventfd.h>
+#include <sys/socket.h>
+#include <sys/un.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <map>
+#include <memory>
+
+namespace nerrf {
+
+// ---- FrameQueue -----------------------------------------------------------
+
+bool FrameQueue::push(const std::string &frame) {
+  std::lock_guard<std::mutex> lock(mu_);
+  if (closed_) return false;
+  if (q_.size() >= slots_) return false;  // drop-on-full
+  q_.push_back(frame);
+  if (efd_ >= 0) {
+    uint64_t one = 1;
+    ssize_t r = write(efd_, &one, 8);
+    (void)r;
+  }
+  return true;
+}
+
+bool FrameQueue::pop(std::string *out, int timeout_ms) {
+  // lazily create the eventfd on the consumer side
+  {
+    std::lock_guard<std::mutex> lock(mu_);
+    if (efd_ < 0) efd_ = eventfd(0, EFD_NONBLOCK);
+    if (!q_.empty()) {
+      *out = std::move(q_.front());
+      q_.pop_front();
+      return true;
+    }
+    if (closed_) return false;
+  }
+  struct pollfd pfd = {efd_, POLLIN, 0};
+  poll(&pfd, 1, timeout_ms);
+  uint64_t n;
+  ssize_t r = read(efd_, &n, 8);
+  (void)r;
+  std::lock_guard<std::mutex> lock(mu_);
+  if (q_.empty()) return false;
+  *out = std::move(q_.front());
+  q_.pop_front();
+  return true;
+}
+
+void FrameQueue::close() {
+  std::lock_guard<std::mutex> lock(mu_);
+  closed_ = true;
+  if (efd_ >= 0) {
+    uint64_t one = 1;
+    ssize_t r = write(efd_, &one, 8);
+    (void)r;
+  }
+}
+
+bool FrameQueue::closed() {
+  std::lock_guard<std::mutex> lock(mu_);
+  return closed_ && q_.empty();
+}
+
+// ---- HPACK structural decoder --------------------------------------------
+
+namespace {
+
+struct HpackEntry {
+  std::string name, value;
+  bool opaque;  // huffman-coded somewhere we didn't decode
+  size_t size;  // RFC size: name + value + 32 (coded length if opaque)
+};
+
+struct HpackDecoder {
+  std::deque<HpackEntry> dynamic;  // front = newest
+  size_t table_size = 0;
+  size_t max_size = 4096;
+
+  void evict() {
+    while (table_size > max_size && !dynamic.empty()) {
+      table_size -= dynamic.back().size;
+      dynamic.pop_back();
+    }
+  }
+
+  void add(HpackEntry e) {
+    e.size = e.name.size() + e.value.size() + 32;
+    table_size += e.size;
+    dynamic.push_front(std::move(e));
+    evict();
+  }
+};
+
+// static table entries we actually need to recognize (RFC 7541 App. A)
+const char *static_name(int idx) {
+  switch (idx) {
+    case 1: return ":authority";
+    case 2: case 3: return ":method";
+    case 4: case 5: return ":path";
+    case 6: case 7: return ":scheme";
+    case 8: case 9: case 10: case 11: case 12: case 13: case 14:
+      return ":status";
+    case 31: return "content-type";
+    default: return "";
+  }
+}
+const char *static_value(int idx) {
+  switch (idx) {
+    case 2: return "GET";
+    case 3: return "POST";
+    case 4: return "/";
+    case 5: return "/index.html";
+    default: return "";
+  }
+}
+
+// HPACK integer, N-bit prefix. Returns false on truncation.
+bool hpack_int(const uint8_t *&p, const uint8_t *end, int prefix,
+               uint64_t *out) {
+  if (p >= end) return false;
+  uint64_t max_pfx = (1u << prefix) - 1;
+  uint64_t v = *p & max_pfx;
+  ++p;
+  if (v < max_pfx) {
+    *out = v;
+    return true;
+  }
+  int shift = 0;
+  while (p < end) {
+    uint8_t b = *p++;
+    v += static_cast<uint64_t>(b & 0x7f) << shift;
+    shift += 7;
+    if (!(b & 0x80)) {
+      *out = v;
+      return true;
+    }
+    if (shift > 56) return false;
+  }
+  return false;
+}
+
+// String literal: sets `opaque` when huffman-coded (content not decoded).
+bool hpack_string(const uint8_t *&p, const uint8_t *end, std::string *out,
+                  bool *opaque) {
+  if (p >= end) return false;
+  bool huff = (*p & 0x80) != 0;
+  uint64_t len;
+  if (!hpack_int(p, end, 7, &len)) return false;
+  if (p + len > end) return false;
+  out->assign(reinterpret_cast<const char *>(p), len);
+  p += len;
+  *opaque = huff;
+  if (huff) *out = "";  // content unknown
+  return true;
+}
+
+// Decode a HEADERS block far enough to find :path (empty + opaque_path=true
+// when it was huffman-coded).  Returns false on malformed input.
+bool hpack_decode_path(HpackDecoder &dec, const uint8_t *p,
+                       const uint8_t *end, std::string *path,
+                       bool *opaque_path) {
+  *path = "";
+  *opaque_path = false;
+  while (p < end) {
+    uint8_t b = *p;
+    if (b & 0x80) {  // indexed
+      uint64_t idx;
+      if (!hpack_int(p, end, 7, &idx) || idx == 0) return false;
+      std::string name, value;
+      bool opaque = false;
+      if (idx <= 61) {
+        name = static_name(static_cast<int>(idx));
+        value = static_value(static_cast<int>(idx));
+      } else if (idx - 62 < dec.dynamic.size()) {
+        const HpackEntry &e = dec.dynamic[idx - 62];
+        name = e.name;
+        value = e.value;
+        opaque = e.opaque;
+      } else {
+        return false;
+      }
+      if (name == ":path") {
+        *path = value;
+        *opaque_path = opaque;
+      }
+    } else if (b & 0x40) {  // literal, incremental indexing
+      uint64_t idx;
+      if (!hpack_int(p, end, 6, &idx)) return false;
+      HpackEntry e;
+      e.opaque = false;
+      bool op_n = false, op_v = false;
+      if (idx == 0) {
+        if (!hpack_string(p, end, &e.name, &op_n)) return false;
+      } else if (idx <= 61) {
+        e.name = static_name(static_cast<int>(idx));
+      } else if (idx - 62 < dec.dynamic.size()) {
+        e.name = dec.dynamic[idx - 62].name;
+        op_n = dec.dynamic[idx - 62].opaque;
+      } else {
+        return false;
+      }
+      if (!hpack_string(p, end, &e.value, &op_v)) return false;
+      e.opaque = op_n || op_v;
+      if (e.name == ":path") {
+        *path = e.value;
+        *opaque_path = e.opaque;
+      }
+      dec.add(std::move(e));
+    } else if ((b & 0xe0) == 0x20) {  // dynamic table size update
+      uint64_t sz;
+      if (!hpack_int(p, end, 5, &sz)) return false;
+      dec.max_size = sz;
+      dec.evict();
+    } else {  // literal without indexing / never indexed (prefix 4)
+      uint64_t idx;
+      if (!hpack_int(p, end, 4, &idx)) return false;
+      std::string name, value;
+      bool op_n = false, op_v = false;
+      if (idx == 0) {
+        if (!hpack_string(p, end, &name, &op_n)) return false;
+      } else if (idx <= 61) {
+        name = static_name(static_cast<int>(idx));
+      } else if (idx - 62 < dec.dynamic.size()) {
+        name = dec.dynamic[idx - 62].name;
+        op_n = dec.dynamic[idx - 62].opaque;
+      } else {
+        return false;
+      }
+      if (!hpack_string(p, end, &value, &op_v)) return false;
+      if (name == ":path") {
+        *path = value;
+        *opaque_path = op_n || op_v;
+      }
+    }
+  }
+  return true;
+}
+
+// ---- frame I/O ------------------------------------------------------------
+
+constexpr uint8_t kFrameData = 0x0;
+constexpr uint8_t kFrameHeaders = 0x1;
+constexpr uint8_t kFrameRstStream = 0x3;
+constexpr uint8_t kFrameSettings = 0x4;
+constexpr uint8_t kFramePing = 0x6;
+constexpr uint8_t kFrameGoaway = 0x7;
+constexpr uint8_t kFrameWindowUpdate = 0x8;
+
+constexpr uint8_t kFlagEndStream = 0x1;
+constexpr uint8_t kFlagAck = 0x1;
+constexpr uint8_t kFlagEndHeaders = 0x4;
+
+bool read_full(int fd, void *buf, size_t n) {
+  uint8_t *p = static_cast<uint8_t *>(buf);
+  while (n > 0) {
+    ssize_t r = recv(fd, p, n, 0);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool write_full(int fd, const void *buf, size_t n) {
+  const uint8_t *p = static_cast<const uint8_t *>(buf);
+  while (n > 0) {
+    ssize_t r = send(fd, p, n, MSG_NOSIGNAL);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool send_frame(int fd, uint8_t type, uint8_t flags, uint32_t stream,
+                const std::string &payload) {
+  uint8_t hdr[9];
+  uint32_t len = static_cast<uint32_t>(payload.size());
+  hdr[0] = (len >> 16) & 0xff;
+  hdr[1] = (len >> 8) & 0xff;
+  hdr[2] = len & 0xff;
+  hdr[3] = type;
+  hdr[4] = flags;
+  hdr[5] = (stream >> 24) & 0x7f;
+  hdr[6] = (stream >> 16) & 0xff;
+  hdr[7] = (stream >> 8) & 0xff;
+  hdr[8] = stream & 0xff;
+  if (!write_full(fd, hdr, 9)) return false;
+  return payload.empty() || write_full(fd, payload.data(), payload.size());
+}
+
+// response headers / trailers, encoded literal-without-indexing (no state)
+std::string lit(const std::string &name, const std::string &value) {
+  std::string s;
+  s.push_back(0x00);
+  s.push_back(static_cast<char>(name.size()));  // names < 127 bytes here
+  s += name;
+  s.push_back(static_cast<char>(value.size()));
+  s += value;
+  return s;
+}
+
+}  // namespace
+
+// ---- server ---------------------------------------------------------------
+
+GrpcStreamServer::GrpcStreamServer(const std::string &listen_addr,
+                                   const std::string &path)
+    : addr_(listen_addr), path_(path) {}
+
+GrpcStreamServer::~GrpcStreamServer() { stop(); }
+
+int GrpcStreamServer::start() {
+  if (addr_.rfind("unix:", 0) == 0) {
+    // unix-domain listener: this is the path where SO_PEERCRED actually
+    // yields the peer pid (TCP always reports 0), i.e. where the daemon's
+    // client pid-exclusion works — local clients should prefer it
+    uds_path_ = addr_.substr(5);
+    listen_fd_ = socket(AF_UNIX, SOCK_STREAM | SOCK_CLOEXEC, 0);
+    if (listen_fd_ < 0) return -1;
+    struct sockaddr_un su;
+    memset(&su, 0, sizeof(su));
+    su.sun_family = AF_UNIX;
+    if (uds_path_.size() >= sizeof(su.sun_path)) return -1;
+    memcpy(su.sun_path, uds_path_.c_str(), uds_path_.size());
+    unlink(uds_path_.c_str());  // stale socket from a previous run
+    if (bind(listen_fd_, reinterpret_cast<struct sockaddr *>(&su),
+             sizeof(su)) < 0 ||
+        listen(listen_fd_, 16) < 0) {
+      ::close(listen_fd_);
+      listen_fd_ = -1;
+      return -1;
+    }
+    port_ = 0;
+    accept_thread_ = std::thread([this] { accept_loop(); });
+    return 0;
+  }
+
+  std::string host = "127.0.0.1";
+  int port = 50051;
+  auto colon = addr_.rfind(':');
+  if (colon != std::string::npos) {
+    host = addr_.substr(0, colon);
+    port = atoi(addr_.c_str() + colon + 1);
+  }
+  if (host.empty() || host == "0.0.0.0") host = "0.0.0.0";
+
+  listen_fd_ = socket(AF_INET, SOCK_STREAM | SOCK_CLOEXEC, 0);
+  if (listen_fd_ < 0) return -1;
+  int one = 1;
+  setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  struct sockaddr_in sa;
+  memset(&sa, 0, sizeof(sa));
+  sa.sin_family = AF_INET;
+  sa.sin_port = htons(static_cast<uint16_t>(port));
+  inet_pton(AF_INET, host.c_str(), &sa.sin_addr);
+  if (bind(listen_fd_, reinterpret_cast<struct sockaddr *>(&sa),
+           sizeof(sa)) < 0 ||
+      listen(listen_fd_, 16) < 0) {
+    ::close(listen_fd_);
+    listen_fd_ = -1;
+    return -1;
+  }
+  socklen_t slen = sizeof(sa);
+  getsockname(listen_fd_, reinterpret_cast<struct sockaddr *>(&sa), &slen);
+  port_ = ntohs(sa.sin_port);
+  accept_thread_ = std::thread([this] { accept_loop(); });
+  return port_;
+}
+
+void GrpcStreamServer::stop() {
+  if (stopping_.exchange(true)) return;
+  if (listen_fd_ >= 0) shutdown(listen_fd_, SHUT_RDWR);
+  if (accept_thread_.joinable()) accept_thread_.join();
+  if (listen_fd_ >= 0) ::close(listen_fd_);
+  listen_fd_ = -1;
+  if (!uds_path_.empty()) unlink(uds_path_.c_str());
+  std::lock_guard<std::mutex> lock(conns_mu_);
+  for (auto &t : conns_)
+    if (t.joinable()) t.join();
+  conns_.clear();
+}
+
+void GrpcStreamServer::accept_loop() {
+  while (!stopping_.load()) {
+    int fd = accept4(listen_fd_, nullptr, nullptr, SOCK_CLOEXEC);
+    if (fd < 0) {
+      if (stopping_.load()) return;
+      continue;
+    }
+    int one = 1;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    if (on_peer_) {
+      struct ucred cred;
+      socklen_t clen = sizeof(cred);
+      int pid = 0;
+      if (getsockopt(fd, SOL_SOCKET, SO_PEERCRED, &cred, &clen) == 0)
+        pid = static_cast<int>(cred.pid);
+      on_peer_(pid);
+    }
+    std::lock_guard<std::mutex> lock(conns_mu_);
+    conns_.emplace_back([this, fd] { handle_conn(fd); });
+  }
+}
+
+void GrpcStreamServer::handle_conn(int fd) {
+  // client connection preface
+  char preface[24];
+  if (!read_full(fd, preface, 24) ||
+      memcmp(preface, "PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n", 24) != 0) {
+    ::close(fd);
+    return;
+  }
+  if (!send_frame(fd, kFrameSettings, 0, 0, "")) {
+    ::close(fd);
+    return;
+  }
+
+  HpackDecoder hpack;
+  int64_t conn_window = 65535;
+  int32_t initial_stream_window = 65535;
+  uint32_t max_frame = 16384;
+
+  struct Stream {
+    int64_t window;
+    std::shared_ptr<FrameQueue> queue;
+    std::string pending;  // bytes accepted from the queue, not yet sent
+    bool open;
+  };
+  std::map<uint32_t, Stream> streams;
+
+  auto close_all = [&] {
+    for (auto &kv : streams)
+      if (kv.second.queue) kv.second.queue->close();
+  };
+
+  // socket is switched to 50 ms read timeout so the loop can interleave
+  // stream writes with control-frame reads
+  struct timeval tv = {0, 50 * 1000};
+  setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+
+  bool alive = true;
+  while (alive && !stopping_.load()) {
+    // 1) pump readable frames (non-blocking-ish via SO_RCVTIMEO)
+    struct pollfd pfd = {fd, POLLIN, 0};
+    int pr = poll(&pfd, 1, streams.empty() ? 100 : 0);
+    if (pr > 0 && (pfd.revents & (POLLIN | POLLHUP))) {
+      uint8_t hdr[9];
+      if (!read_full(fd, hdr, 9)) break;
+      uint32_t len =
+          (uint32_t(hdr[0]) << 16) | (uint32_t(hdr[1]) << 8) | hdr[2];
+      uint8_t type = hdr[3], flags = hdr[4];
+      uint32_t sid = ((uint32_t(hdr[5]) & 0x7f) << 24) |
+                     (uint32_t(hdr[6]) << 16) | (uint32_t(hdr[7]) << 8) |
+                     hdr[8];
+      std::string payload(len, '\0');
+      if (len && !read_full(fd, payload.data(), len)) break;
+      const uint8_t *pp = reinterpret_cast<const uint8_t *>(payload.data());
+
+      switch (type) {
+        case kFrameSettings:
+          if (!(flags & kFlagAck)) {
+            for (size_t i = 0; i + 6 <= payload.size(); i += 6) {
+              uint16_t id = (uint16_t(pp[i]) << 8) | pp[i + 1];
+              uint32_t val = (uint32_t(pp[i + 2]) << 24) |
+                             (uint32_t(pp[i + 3]) << 16) |
+                             (uint32_t(pp[i + 4]) << 8) | pp[i + 5];
+              if (id == 4) initial_stream_window = static_cast<int32_t>(val);
+              if (id == 5 && val >= 16384) max_frame = val;
+            }
+            if (!send_frame(fd, kFrameSettings, kFlagAck, 0, "")) alive = false;
+          }
+          break;
+        case kFramePing:
+          if (!(flags & kFlagAck))
+            if (!send_frame(fd, kFramePing, kFlagAck, 0, payload))
+              alive = false;
+          break;
+        case kFrameWindowUpdate: {
+          if (payload.size() >= 4) {
+            uint32_t inc = ((uint32_t(pp[0]) & 0x7f) << 24) |
+                           (uint32_t(pp[1]) << 16) | (uint32_t(pp[2]) << 8) |
+                           pp[3];
+            if (sid == 0)
+              conn_window += inc;
+            else if (streams.count(sid))
+              streams[sid].window += inc;
+          }
+          break;
+        }
+        case kFrameHeaders: {
+          // strip optional padding/priority
+          const uint8_t *hp = pp;
+          const uint8_t *hend = pp + payload.size();
+          if (flags & 0x8) {  // PADDED
+            uint8_t pad = *hp++;
+            hend -= pad;
+          }
+          if (flags & 0x20) hp += 5;  // PRIORITY
+          std::string rpath;
+          bool opaque = false;
+          if (!hpack_decode_path(hpack, hp, hend, &rpath, &opaque)) {
+            alive = false;
+            break;
+          }
+          if (!(flags & kFlagEndHeaders)) {
+            // CONTINUATION unsupported (request headers for one short path
+            // never need it); drop the connection rather than desync HPACK
+            alive = false;
+            break;
+          }
+          if (!opaque && !rpath.empty() && rpath != path_) {
+            // plaintext path mismatch → UNIMPLEMENTED trailers-only
+            std::string h = std::string(1, char(0x88)) +
+                            lit("content-type", "application/grpc") +
+                            lit("grpc-status", "12");
+            send_frame(fd, kFrameHeaders,
+                       kFlagEndHeaders | kFlagEndStream, sid, h);
+            break;
+          }
+          Stream st;
+          st.window = initial_stream_window;
+          st.queue = subscribe_ ? subscribe_() : nullptr;
+          st.open = true;
+          // response headers
+          std::string h = std::string(1, char(0x88)) +
+                          lit("content-type", "application/grpc");
+          if (!send_frame(fd, kFrameHeaders, kFlagEndHeaders, sid, h)) {
+            alive = false;
+            break;
+          }
+          streams[sid] = std::move(st);
+          subscribers_.fetch_add(1);
+          break;
+        }
+        case kFrameData:
+          break;  // Empty request payload — nothing to do
+        case kFrameRstStream:
+          if (streams.count(sid)) {
+            if (streams[sid].queue) streams[sid].queue->close();
+            streams.erase(sid);
+            subscribers_.fetch_sub(1);
+          }
+          break;
+        case kFrameGoaway:
+          alive = false;
+          break;
+        default:
+          break;  // PRIORITY, PUSH_PROMISE (n/a), unknown: ignore
+      }
+      continue;  // favor reads while frames are arriving
+    }
+
+    // 2) write pass: move queued gRPC messages into DATA frames within
+    //    flow-control limits
+    bool wrote = false;
+    for (auto it = streams.begin(); alive && it != streams.end();) {
+      Stream &st = it->second;
+      if (st.pending.empty() && st.queue) {
+        std::string msg;
+        if (st.queue->pop(&msg, 0)) st.pending = std::move(msg);
+      }
+      if (!st.pending.empty() && st.window > 0 && conn_window > 0) {
+        size_t n = std::min({st.pending.size(),
+                             static_cast<size_t>(st.window),
+                             static_cast<size_t>(conn_window),
+                             static_cast<size_t>(max_frame)});
+        std::string chunk = st.pending.substr(0, n);
+        if (!send_frame(fd, kFrameData, 0, it->first, chunk)) {
+          alive = false;
+          break;
+        }
+        st.pending.erase(0, n);
+        st.window -= static_cast<int64_t>(n);
+        conn_window -= static_cast<int64_t>(n);
+        wrote = true;
+      }
+      if (st.queue && st.queue->closed() && st.pending.empty()) {
+        // source finished: trailers, END_STREAM
+        std::string t = lit("grpc-status", "0");
+        send_frame(fd, kFrameHeaders, kFlagEndHeaders | kFlagEndStream,
+                   it->first, t);
+        subscribers_.fetch_sub(1);
+        it = streams.erase(it);
+        continue;
+      }
+      ++it;
+    }
+    if (!wrote && pr <= 0) {
+      // nothing read, nothing written: block briefly on the first stream's
+      // queue (or just yield) so the loop doesn't spin
+      if (!streams.empty()) {
+        Stream &st = streams.begin()->second;
+        if (st.pending.empty() && st.queue) {
+          std::string msg;
+          if (st.queue->pop(&msg, 20)) st.pending = std::move(msg);
+        } else {
+          usleep(5000);
+        }
+      }
+    }
+  }
+  for (auto &kv : streams) {
+    if (kv.second.queue) kv.second.queue->close();
+    subscribers_.fetch_sub(1);
+  }
+  close_all();
+  ::close(fd);
+}
+
+}  // namespace nerrf

@@ -1,0 +1,98 @@
+// Minimal HTTP/2 server — exactly enough of RFC 7540/7541 to serve one gRPC
+// server-streaming method (`/nerrf.trace.Tracker/StreamEvents`) to standard
+// clients (grpcio, grpcurl, grpc-go).
+//
+// Why hand-rolled: the build image has no grpc++ (and no package installs),
+// and the reference's tracker is a single self-contained native binary
+// (`/root/reference/tracker/cmd/tracker/main.go:113-148`).  Scope kept
+// deliberately small:
+//   * server side of one server-streaming RPC; request payload ignored
+//     (the method takes Empty);
+//   * HPACK is decoded structurally (integers, string lengths, dynamic-table
+//     bookkeeping).  Huffman-coded header *values* are not decoded — a
+//     huffman :path is accepted as a wildcard match, since this server binds
+//     exactly one method (same posture as grpc's generic handler).  Dynamic
+//     table sizes for huffman entries use the coded length (slight
+//     underestimate); fine for the one-RPC-per-connection gRPC pattern.
+//   * flow control honored on both connection and stream windows;
+//     PING/SETTINGS/WINDOW_UPDATE/RST_STREAM/GOAWAY handled.
+#ifndef NERRF_H2GRPC_H_
+#define NERRF_H2GRPC_H_
+
+#include <atomic>
+#include <cstdint>
+#include <deque>
+#include <functional>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace nerrf {
+
+// A subscriber's frame queue: bounded, drop-on-full — the reference daemon's
+// slow-client isolation policy (main.go:255-265, 100-slot channels).
+class FrameQueue {
+ public:
+  explicit FrameQueue(size_t slots = 100) : slots_(slots) {}
+
+  bool push(const std::string &frame);  // false = dropped (queue full)
+  // Pop one frame; blocks up to timeout_ms. empty string = timeout/closed.
+  bool pop(std::string *out, int timeout_ms);
+  void close();
+  bool closed();
+
+ private:
+  std::mutex mu_;
+  std::deque<std::string> q_;
+  size_t slots_;
+  bool closed_ = false;
+  int efd_ = -1;
+};
+
+class GrpcStreamServer {
+ public:
+  // `path` is the only method served. on_subscribe is called per stream; the
+  // returned queue feeds gRPC message payloads (already length-prefixed by
+  // the server). on_unsubscribe releases it.
+  // listen_addr: "HOST:PORT" (TCP) or "unix:/path" (unix-domain socket —
+  // required for working peer-pid exclusion; SO_PEERCRED is AF_UNIX-only).
+  GrpcStreamServer(const std::string &listen_addr, const std::string &path);
+  ~GrpcStreamServer();
+
+  using Subscribe = std::function<std::shared_ptr<FrameQueue>()>;
+  void set_subscribe(Subscribe fn) { subscribe_ = fn; }
+
+  // Called with the peer's pid (SO_PEERCRED; 0 if unavailable) as each
+  // connection is accepted — the daemon uses it for capture self-exclusion.
+  using OnPeer = std::function<void(int pid)>;
+  void set_on_peer(OnPeer fn) { on_peer_ = fn; }
+
+  int start();  // returns bound port, or -1
+  void stop();
+
+  int port() const { return port_; }
+  uint64_t subscribers() const { return subscribers_.load(); }
+
+ private:
+  void accept_loop();
+  void handle_conn(int fd);
+
+  std::string addr_;
+  std::string path_;
+  std::string uds_path_;
+  Subscribe subscribe_;
+  OnPeer on_peer_;
+  int listen_fd_ = -1;
+  int port_ = -1;
+  std::atomic<bool> stopping_{false};
+  std::atomic<uint64_t> subscribers_{0};
+  std::thread accept_thread_;
+  std::mutex conns_mu_;
+  std::vector<std::thread> conns_;
+};
+
+}  // namespace nerrf
+
+#endif  // NERRF_H2GRPC_H_

@@ -1,0 +1,303 @@
+// nerrf-trackerd: the live capture daemon — kernel ring buffer → gRPC.
+//
+// The working equivalent of the reference's tracker binary
+// (`/root/reference/tracker/cmd/tracker/main.go:69-156`: load BPF, mmap the
+// ring, decode, fan out `nerrf.trace.Tracker/StreamEvents` to all clients),
+// as one self-contained native binary:
+//
+//   capture (src/capture.cc, raw bpf(2), no clang/libbpf needed)
+//     → decode + monotonic→wall correction + sanitize
+//     → protobuf EventBatch frames (real batching, 64 events/frame — the
+//       reference sends 1 event per frame despite its envelope, main.go:252)
+//     → per-subscriber bounded queues, drop-on-full (main.go:255-265 policy)
+//     → minimal HTTP/2 gRPC server (src/h2grpc.cc)
+//
+// Exit codes: 0 ok · 2 no permission (CAP_BPF) · 3 kernel support missing —
+// scripts skip cleanly on 2/3 instead of failing.
+//
+// Usage: nerrf-trackerd [--listen HOST:PORT] [--batch N] [--ringbuf BYTES]
+//                       [--max-seconds S] [--capture-self] [--probe]
+//   TRACKER_LISTEN_ADDR honored like the reference (main.go:113).
+
+#include <signal.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <time.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "h2grpc.h"
+#include "nerrf/capture.h"
+
+namespace {
+
+// ---- tiny protobuf writer (proto/trace.proto field numbers) ---------------
+
+void put_varint(std::string &s, uint64_t v) {
+  while (v >= 0x80) {
+    s.push_back(static_cast<char>(v | 0x80));
+    v >>= 7;
+  }
+  s.push_back(static_cast<char>(v));
+}
+
+void put_tag(std::string &s, int field, int wire) {
+  put_varint(s, static_cast<uint64_t>(field) << 3 | wire);
+}
+
+void put_str(std::string &s, int field, const char *data, size_t len) {
+  if (len == 0) return;
+  put_tag(s, field, 2);
+  put_varint(s, len);
+  s.append(data, len);
+}
+
+void put_u64(std::string &s, int field, uint64_t v) {
+  if (v == 0) return;
+  put_tag(s, field, 0);
+  put_varint(s, v);
+}
+
+void put_sint64(std::string &s, int field, int64_t v) {
+  if (v == 0) return;
+  put_tag(s, field, 0);
+  put_varint(s, (static_cast<uint64_t>(v) << 1) ^
+                    static_cast<uint64_t>(v >> 63));  // zigzag
+}
+
+// task comms / paths can carry control bytes; keep printable ASCII only
+// (reference sanitizeString, main.go:327-334)
+size_t sanitize(const char *in, size_t maxlen, char *out) {
+  size_t n = 0;
+  for (size_t i = 0; i < maxlen && in[i]; ++i)
+    if (in[i] >= 0x20 && in[i] < 0x7f) out[n++] = in[i];
+  return n;
+}
+
+const char *syscall_name(uint32_t sc) {
+  // keep in sync with nerrf_tpu/schema/events.py::Syscall
+  static const char *names[] = {"openat", "write",   "rename", "read",
+                                "unlink", "close",   "exec",   "connect",
+                                "stat",   "mkdir",   "chmod",  "fsync",
+                                "marker", "other"};
+  return sc < sizeof(names) / sizeof(names[0]) ? names[sc] : "other";
+}
+
+struct Stats {
+  std::atomic<uint64_t> events{0};
+  std::atomic<uint64_t> frames{0};
+  std::atomic<uint64_t> frames_dropped{0};
+};
+
+class Broadcaster {
+ public:
+  std::shared_ptr<nerrf::FrameQueue> subscribe() {
+    auto q = std::make_shared<nerrf::FrameQueue>(100);
+    std::lock_guard<std::mutex> lock(mu_);
+    queues_.push_back(q);
+    return q;
+  }
+
+  void publish(const std::string &frame, Stats &st) {
+    std::lock_guard<std::mutex> lock(mu_);
+    for (auto it = queues_.begin(); it != queues_.end();) {
+      auto q = it->lock();
+      if (!q) {
+        it = queues_.erase(it);
+        continue;
+      }
+      if (!q->push(frame)) st.frames_dropped.fetch_add(1);
+      ++it;
+    }
+  }
+
+  void close_all() {
+    std::lock_guard<std::mutex> lock(mu_);
+    for (auto &w : queues_)
+      if (auto q = w.lock()) q->close();
+    queues_.clear();
+  }
+
+ private:
+  std::mutex mu_;
+  std::vector<std::weak_ptr<nerrf::FrameQueue>> queues_;
+};
+
+struct CaptureCtx {
+  std::string batch;        // EventBatch under construction
+  int events_in_batch = 0;
+  int batch_size = 64;
+  int64_t boot_wall_ns = 0;  // CLOCK_REALTIME - CLOCK_MONOTONIC at startup
+  Broadcaster *bcast = nullptr;
+  Stats *stats = nullptr;
+};
+
+void flush_batch(CaptureCtx *cx) {
+  if (cx->events_in_batch == 0) return;
+  // gRPC message framing: flag byte + 4-byte big-endian length + payload
+  std::string msg;
+  msg.reserve(cx->batch.size() + 5);
+  msg.push_back(0);
+  uint32_t len = static_cast<uint32_t>(cx->batch.size());
+  msg.push_back(static_cast<char>((len >> 24) & 0xff));
+  msg.push_back(static_cast<char>((len >> 16) & 0xff));
+  msg.push_back(static_cast<char>((len >> 8) & 0xff));
+  msg.push_back(static_cast<char>(len & 0xff));
+  msg += cx->batch;
+  cx->bcast->publish(msg, *cx->stats);
+  cx->stats->frames.fetch_add(1);
+  cx->batch.clear();
+  cx->events_in_batch = 0;
+}
+
+void on_event(void *user, const struct nerrf_event_record *rec) {
+  CaptureCtx *cx = static_cast<CaptureCtx *>(user);
+  std::string ev;
+  ev.reserve(96);
+
+  // ts: google.protobuf.Timestamp {1: seconds, 2: nanos}
+  int64_t wall = cx->boot_wall_ns + static_cast<int64_t>(rec->ts_ns);
+  std::string ts;
+  put_u64(ts, 1, static_cast<uint64_t>(wall / 1000000000ll));
+  put_u64(ts, 2, static_cast<uint64_t>(wall % 1000000000ll));
+  put_str(ev, 1, ts.data(), ts.size());
+
+  put_u64(ev, 2, rec->pid);
+  put_u64(ev, 3, rec->tid);
+  char buf[NERRF_PATH_LEN];
+  put_str(ev, 4, buf, sanitize(rec->comm, NERRF_COMM_LEN, buf));
+  const char *sc = syscall_name(rec->syscall_id);
+  put_str(ev, 5, sc, strlen(sc));
+  put_str(ev, 6, buf, sanitize(rec->path, NERRF_PATH_LEN, buf));
+  put_str(ev, 7, buf, sanitize(rec->new_path, NERRF_PATH_LEN, buf));
+  put_sint64(ev, 9, rec->ret_val);
+  put_u64(ev, 10, rec->bytes);
+
+  // EventBatch.events (field 1)
+  put_tag(cx->batch, 1, 2);
+  put_varint(cx->batch, ev.size());
+  cx->batch += ev;
+  cx->stats->events.fetch_add(1);
+  if (++cx->events_in_batch >= cx->batch_size) flush_batch(cx);
+}
+
+std::atomic<bool> g_stop{false};
+void on_signal(int) { g_stop.store(true); }
+
+}  // namespace
+
+int main(int argc, char **argv) {
+  const char *env_addr = getenv("TRACKER_LISTEN_ADDR");
+  std::string listen = env_addr ? env_addr : "127.0.0.1:50051";
+  uint32_t ringbuf_bytes = 256 * 1024;
+  int batch_size = 64;
+  int max_seconds = 0;
+  bool capture_self = false;
+  bool probe_only = false;
+
+  for (int i = 1; i < argc; ++i) {
+    std::string a = argv[i];
+    auto next = [&]() -> const char * {
+      return i + 1 < argc ? argv[++i] : "";
+    };
+    if (a == "--listen") listen = next();
+    else if (a == "--ringbuf") ringbuf_bytes = atoi(next());
+    else if (a == "--batch") batch_size = atoi(next());
+    else if (a == "--max-seconds") max_seconds = atoi(next());
+    else if (a == "--capture-self") capture_self = true;
+    else if (a == "--probe") probe_only = true;
+    else {
+      fprintf(stderr, "usage: %s [--listen H:P] [--ringbuf B] [--batch N] "
+                      "[--max-seconds S] [--capture-self] [--probe]\n",
+              argv[0]);
+      return 1;
+    }
+  }
+
+  char err[1024] = {0};
+  int st = nerrf_capture_probe(err, sizeof(err));
+  if (st != NERRF_CAPTURE_OK) {
+    fprintf(stderr, "[trackerd] capture unavailable: %s\n", err);
+    return st == NERRF_CAPTURE_EPERM ? 2 : 3;
+  }
+  if (probe_only) {
+    printf("capture ok\n");
+    return 0;
+  }
+
+  nerrf_capture *cap = nerrf_capture_open(
+      ringbuf_bytes, capture_self ? 0 : getpid(), err, sizeof(err));
+  if (!cap) {
+    fprintf(stderr, "[trackerd] capture open failed: %s\n", err);
+    return 3;
+  }
+
+  Broadcaster bcast;
+  Stats stats;
+  nerrf::GrpcStreamServer server(listen, "/nerrf.trace.Tracker/StreamEvents");
+  server.set_subscribe([&] { return bcast.subscribe(); });
+  server.set_on_peer([&](int pid) {
+    if (pid > 0) nerrf_capture_exclude_pid(cap, pid);
+  });
+  int port = server.start();
+  if (port < 0) {
+    fprintf(stderr, "[trackerd] listen on %s failed\n", listen.c_str());
+    nerrf_capture_close(cap);
+    return 1;
+  }
+  fprintf(stderr, "[trackerd] capturing; serving StreamEvents on %s\n",
+          listen.c_str());
+  if (listen.rfind("unix:", 0) != 0)
+    fprintf(stderr,
+            "[trackerd] note: TCP clients cannot be pid-excluded "
+            "(SO_PEERCRED is unix-socket-only); local subscribers should "
+            "use --listen unix:/path to avoid capture feedback\n");
+
+  struct timespec rt, mt;
+  clock_gettime(CLOCK_REALTIME, &rt);
+  clock_gettime(CLOCK_MONOTONIC, &mt);
+  CaptureCtx cx;
+  cx.batch_size = batch_size;
+  cx.boot_wall_ns = (rt.tv_sec - mt.tv_sec) * 1000000000ll +
+                    (rt.tv_nsec - mt.tv_nsec);
+  cx.bcast = &bcast;
+  cx.stats = &stats;
+
+  signal(SIGINT, on_signal);
+  signal(SIGTERM, on_signal);
+
+  time_t start = time(nullptr);
+  time_t last_log = start;
+  while (!g_stop.load()) {
+    nerrf_capture_poll(cap, 100, on_event, &cx);
+    flush_batch(&cx);  // latency bound: ship partial batches every poll round
+    time_t now = time(nullptr);
+    if (max_seconds > 0 && now - start >= max_seconds) break;
+    if (now - last_log >= 10) {
+      fprintf(stderr,
+              "[trackerd] events=%llu frames=%llu dropped_kernel=%llu "
+              "dropped_frames=%llu subscribers=%llu\n",
+              (unsigned long long)stats.events.load(),
+              (unsigned long long)stats.frames.load(),
+              (unsigned long long)nerrf_capture_dropped(cap),
+              (unsigned long long)stats.frames_dropped.load(),
+              (unsigned long long)server.subscribers());
+      last_log = now;
+    }
+  }
+
+  fprintf(stderr, "[trackerd] shutting down: events=%llu kernel_dropped=%llu\n",
+          (unsigned long long)stats.events.load(),
+          (unsigned long long)nerrf_capture_dropped(cap));
+  bcast.close_all();
+  server.stop();
+  nerrf_capture_close(cap);
+  return 0;
+}

@@ -1,0 +1,141 @@
+"""Live kernel capture: the native daemon (hand-assembled eBPF via raw
+bpf(2) + minimal HTTP/2 gRPC server) end-to-end against the Python client.
+
+Equivalent-of test for the reference's tracker-in-the-loop E2E
+(`/root/reference/tracker/scripts/test.sh`: stream 15 s, pass on >=10
+.dat/.lockbit events) — but cluster-free and with graceful capability
+detection: on kernels/containers without BPF permissions the whole module
+skips instead of failing (the daemon's documented exit codes 2/3).
+"""
+
+import os
+import subprocess
+import threading
+import time
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+DAEMON = REPO / "native" / "build" / "nerrf-trackerd"
+
+
+def _build_daemon() -> None:
+    if DAEMON.exists():
+        return
+    r = subprocess.run(
+        ["make", "-C", str(REPO / "native"), "build/nerrf-trackerd"],
+        capture_output=True, text=True,
+    )
+    if r.returncode != 0:
+        pytest.skip(f"daemon build failed: {r.stderr[-400:]}")
+
+
+@pytest.fixture(scope="module")
+def live_daemon():
+    _build_daemon()
+    probe = subprocess.run([str(DAEMON), "--probe"], capture_output=True,
+                           text=True)
+    if probe.returncode in (2, 3):
+        pytest.skip(f"live capture unavailable: {probe.stderr.strip()}")
+    assert probe.returncode == 0, probe.stderr
+
+    port = 50871
+    proc = subprocess.Popen(
+        [str(DAEMON), "--listen", f"127.0.0.1:{port}", "--max-seconds", "60"],
+        stderr=subprocess.PIPE, text=True,
+    )
+    time.sleep(0.8)
+    assert proc.poll() is None, proc.stderr.read()
+    yield port
+    proc.terminate()
+    proc.wait(timeout=10)
+
+
+def test_probe_exit_codes():
+    """--probe must exit 0 (usable), 2 (no permission) or 3 (no support) —
+    never crash — so scripts can branch on it."""
+    _build_daemon()
+    r = subprocess.run([str(DAEMON), "--probe"], capture_output=True)
+    assert r.returncode in (0, 2, 3)
+
+
+def test_live_capture_streams_kernel_events(live_daemon, tmp_path):
+    """Kernel → eBPF ring → daemon → gRPC → client: scripted file activity
+    must arrive as decoded events with correct syscalls and paths."""
+    port = live_daemon
+    stop = threading.Event()
+
+    def activity():
+        i = 0
+        while not stop.is_set() and i < 2000:
+            p = tmp_path / f"doc_{i}.dat"
+            p.write_text("confidential")
+            os.rename(p, p.with_suffix(".dat.lockbit3"))
+            os.unlink(p.with_suffix(".dat.lockbit3"))
+            i += 1
+            time.sleep(0.01)
+
+    t = threading.Thread(target=activity, daemon=True)
+    t.start()
+    try:
+        from nerrf_tpu.ingest.service import TrackerClient
+        from nerrf_tpu.schema.events import Syscall
+
+        client = TrackerClient(f"127.0.0.1:{port}")
+        events, strings = client.stream(max_events=300, timeout=30.0)
+    finally:
+        stop.set()
+        t.join(timeout=5)
+
+    assert events.num_valid > 0, "no live events arrived"
+    valid = events.valid
+    seen = {int(s) for s in events.syscall[valid]}
+    # our own pytest process generates opens+writes+renames+unlinks above;
+    # systemwide noise may add more — the tracked set must be present
+    assert Syscall.RENAME in seen or Syscall.OPENAT in seen
+
+    paths = [strings.lookup(int(i)) for i in events.path_id[valid]]
+    new_paths = [strings.lookup(int(i)) for i in events.new_path_id[valid]]
+    relevant = [p for p in paths + new_paths
+                if ".dat" in p or ".lockbit" in p]
+    assert relevant, f"no attack-relevant paths in {len(paths)} events"
+    # ts sanity: wall-clock within the last hour (monotonic→wall correction)
+    ts = events.ts_ns[valid]
+    now_ns = time.time_ns()
+    assert abs(int(ts[len(ts) // 2]) - now_ns) < 3600 * 10**9
+
+
+def test_live_capture_feeds_trace_store(live_daemon, tmp_path):
+    """Live events persist through the store append/flush path (the `nerrf
+    ingest` daemon-mode pipeline)."""
+    port = live_daemon
+    from nerrf_tpu.graph.store import TraceStore
+    from nerrf_tpu.ingest.service import TrackerClient
+
+    # background activity so the stream has content
+    stop = threading.Event()
+
+    def activity():
+        i = 0
+        while not stop.is_set() and i < 2000:
+            (tmp_path / f"s_{i}.dat").write_text("x")
+            i += 1
+            time.sleep(0.01)
+
+    t = threading.Thread(target=activity, daemon=True)
+    t.start()
+    try:
+        client = TrackerClient(f"127.0.0.1:{port}")
+        total = 0
+        with TraceStore(tmp_path / "store") as st:
+            for ev, strings in client.iter_blocks(max_events=150,
+                                                  timeout=30.0):
+                total += st.append(ev, strings)
+            st.flush()
+            assert total > 0
+            got = st.query_count(0, 2**62)
+            assert got == total
+    finally:
+        stop.set()
+        t.join(timeout=5)
