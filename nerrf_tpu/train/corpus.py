@@ -1,0 +1,229 @@
+"""The 100 h corpus: streaming generation to disk shards + shard reader.
+
+The reference's roadmap specifies a "100 h benign + 1 h labelled attack"
+training corpus (`/root/reference/ROADMAP.md:50`) that was never built; the
+north star (BASELINE.json) asks for detector ROC-AUC *on that corpus*.  At
+production density (600 s traces, 40 Hz benign load ≈ 25 k events/trace)
+100 h is ~600 traces → ~24 k window samples → ~16 GB of window tensors:
+too big to hold in HBM, too big to regenerate per run.  So the corpus is
+generated ONCE, streamed trace-by-trace to fixed-size shards on disk, and
+training rotates shards through the chip (double-buffered uploads — see
+train/loop.py:train_sharded_stream).
+
+Layout (one directory per corpus):
+    manifest.json              — hours, windows, shard list, configs, dtypes
+    shard_0000/{node_feat.npy, ...}
+    shard_0001/...             — each ≤ shard_windows samples, train split
+    eval_0000/...              — held-out TRACES (split before windowing, so
+                                 no window of an eval trace leaks into train)
+
+float32 feature/label arrays are stored as float16 (counts, ratios, Δt and
+{0,1} labels all fit comfortably): halves disk and — the real win — halves
+host→device transfer on a ~0.5 GB/s tunnel.  Readers upcast on device.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import time
+from pathlib import Path
+from typing import Dict, Iterator, List, Optional
+
+import numpy as np
+
+from nerrf_tpu.train.data import DatasetConfig, WindowDataset, windows_of_trace
+
+# float arrays stored as f16 on disk; everything else (masks, int ids like
+# node_aux/node_type — embedding inputs) keeps its dtype
+_F16_KEYS = ("node_feat", "edge_feat", "seq_feat",
+             "node_label", "edge_label", "seq_label")
+
+
+@dataclasses.dataclass(frozen=True)
+class CorpusSpec:
+    """Generation parameters (mirrors config.CorpusConfig at scale)."""
+
+    hours: float = 100.0
+    duration_sec: float = 600.0
+    attack_fraction: float = 0.5
+    num_target_files: int = 24
+    benign_rate_hz: float = 40.0
+    base_seed: int = 1000
+    eval_fraction: float = 0.1     # fraction of TRACES held out
+    shard_windows: int = 2000      # samples per shard (~0.7 GB at f16)
+
+
+def _write_shard(out: Path, samples: List[dict], dtypes: Dict[str, str]) -> int:
+    out.mkdir(parents=True, exist_ok=True)
+    keys = samples[0].keys()
+    for k in keys:
+        arr = np.stack([s[k] for s in samples])
+        dtypes.setdefault(k, str(arr.dtype))
+        if k in _F16_KEYS:
+            arr = arr.astype(np.float16)
+        np.save(out / f"{k}.npy", arr)
+    return len(samples)
+
+
+def generate_corpus(
+    out_dir: str | Path,
+    spec: CorpusSpec = CorpusSpec(),
+    dataset: Optional[DatasetConfig] = None,
+    log=None,
+) -> dict:
+    """Stream-generate `spec.hours` of traces into shards under out_dir.
+
+    Memory stays bounded at one shard of samples (+ one trace); wall clock
+    is ~2 s per 600 s trace on one core, so 100 h ≈ 20 min.  Idempotent:
+    an existing complete manifest short-circuits.
+    """
+    from nerrf_tpu.data.synth import SimConfig, simulate_trace
+
+    out = Path(out_dir)
+    man_path = out / "manifest.json"
+    if man_path.exists():
+        man = json.loads(man_path.read_text())
+        if man.get("complete"):
+            if log:
+                log(f"corpus exists: {man['hours']:.1f}h, "
+                    f"{man['train_windows']} train windows — skipping")
+            return man
+    out.mkdir(parents=True, exist_ok=True)
+    dataset = dataset or DatasetConfig()
+
+    n_traces = max(1, round(spec.hours * 3600.0 / spec.duration_sec))
+    rng = np.random.default_rng(spec.base_seed)
+    is_attack = rng.random(n_traces) < spec.attack_fraction
+    is_eval = rng.random(n_traces) < spec.eval_fraction
+    if spec.eval_fraction > 0 and n_traces >= 2 and not is_eval.any():
+        is_eval[-1] = True  # small corpora must still have a held-out trace
+
+    dtypes: Dict[str, str] = {}
+    shards: List[dict] = []
+    buf: Dict[bool, List[dict]] = {True: [], False: []}  # eval? → samples
+    counts = {"train": 0, "eval": 0}
+    label_pos = {"edge": 0.0, "seq": 0.0}
+    t0 = time.time()
+
+    def flush(eval_split: bool, force: bool = False) -> None:
+        b = buf[eval_split]
+        limit = spec.shard_windows
+        while len(b) >= limit or (force and b):
+            chunk, buf[eval_split] = b[:limit], b[limit:]
+            b = buf[eval_split]
+            kind = "eval" if eval_split else "shard"
+            name = f"{kind}_{sum(1 for s in shards if s['kind'] == kind):04d}"
+            n = _write_shard(out / name, chunk, dtypes)
+            shards.append({"name": name, "kind": kind, "windows": n})
+            counts["eval" if eval_split else "train"] += n
+            if log:
+                log(f"  wrote {name}: {n} windows "
+                    f"({time.time() - t0:.0f}s elapsed)")
+
+    for i in range(n_traces):
+        # structural variety per trace (files, load, attack onset), not just
+        # the sim seed — a fixed onset would be a trivially learnable clock
+        trng = np.random.default_rng((spec.base_seed, i))
+        sim = SimConfig(
+            num_target_files=int(trng.integers(max(4, spec.num_target_files // 2),
+                                               spec.num_target_files + 1)),
+            duration_sec=spec.duration_sec,
+            benign_rate_hz=float(trng.uniform(spec.benign_rate_hz * 0.5,
+                                              spec.benign_rate_hz * 1.5)),
+            attack_start_sec=float(trng.uniform(0.15, 0.7) * spec.duration_sec),
+            seed=spec.base_seed + i,
+            attack=bool(is_attack[i]),
+        )
+        tr = simulate_trace(sim)
+        samples = windows_of_trace(tr, dataset)
+        for s in samples:
+            label_pos["edge"] += float(s["edge_label"].sum())
+            label_pos["seq"] += float(s["seq_label"].sum())
+        buf[bool(is_eval[i])].extend(samples)
+        flush(bool(is_eval[i]))
+        if log and (i + 1) % 50 == 0:
+            log(f"corpus: {i + 1}/{n_traces} traces "
+                f"({(i + 1) * spec.duration_sec / 3600:.1f}h)")
+    flush(False, force=True)
+    flush(True, force=True)
+
+    man = {
+        "complete": True,
+        "hours": n_traces * spec.duration_sec / 3600.0,
+        "num_traces": n_traces,
+        "train_windows": counts["train"],
+        "eval_windows": counts["eval"],
+        "shards": shards,
+        "dtypes": dtypes,
+        "spec": dataclasses.asdict(spec),
+        "gen_seconds": round(time.time() - t0, 1),
+        "label_pos": label_pos,
+    }
+    man_path.write_text(json.dumps(man, indent=2) + "\n")
+    if log:
+        log(f"corpus complete: {man['hours']:.1f}h, "
+            f"{counts['train']} train / {counts['eval']} eval windows in "
+            f"{man['gen_seconds']:.0f}s")
+    return man
+
+
+class ShardedCorpus:
+    """Reader: shard-at-a-time access to a generated corpus directory."""
+
+    def __init__(self, path: str | Path) -> None:
+        self.path = Path(path)
+        man_path = self.path / "manifest.json"
+        if not man_path.exists():
+            raise FileNotFoundError(
+                f"no corpus manifest at {man_path}; generate it with "
+                f"`python scripts/gen_corpus.py --out {self.path}`")
+        self.manifest = json.loads(man_path.read_text())
+        if not self.manifest.get("complete"):
+            raise ValueError(f"corpus at {self.path} is incomplete")
+        self.train_shards = [s["name"] for s in self.manifest["shards"]
+                             if s["kind"] == "shard"]
+        self.eval_shards = [s["name"] for s in self.manifest["shards"]
+                            if s["kind"] == "eval"]
+
+    @property
+    def hours(self) -> float:
+        return float(self.manifest["hours"])
+
+    @property
+    def train_windows(self) -> int:
+        return int(self.manifest["train_windows"])
+
+    def load_shard(self, name: str, upcast: bool = False) -> Dict[str, np.ndarray]:
+        """Arrays of one shard.  f16 storage dtypes are preserved unless
+        `upcast` (host-side f32, for eval paths that never hit the wire)."""
+        d = self.path / name
+        arrays = {p.stem: np.load(p) for p in sorted(d.glob("*.npy"))}
+        if upcast:
+            arrays = {
+                k: v.astype(np.float32) if v.dtype == np.float16 else v
+                for k, v in arrays.items()
+            }
+        return arrays
+
+    def eval_dataset(self, max_windows: int = 4000) -> WindowDataset:
+        """Held-out split as a WindowDataset (host RAM, f32)."""
+        parts, total = [], 0
+        for name in self.eval_shards:
+            arrs = self.load_shard(name, upcast=True)
+            parts.append(WindowDataset(arrs))
+            total += len(parts[-1])
+            if total >= max_windows:
+                break
+        if not parts:
+            raise ValueError("corpus has no eval shards")
+        ds = WindowDataset.concatenate(parts)
+        if len(ds) > max_windows:
+            ds = ds.take(np.arange(max_windows))
+        return ds
+
+    def iter_train_shards(self, epoch_seed: int) -> Iterator[Dict[str, np.ndarray]]:
+        order = np.random.default_rng(epoch_seed).permutation(
+            len(self.train_shards))
+        for i in order:
+            yield self.load_shard(self.train_shards[int(i)])

@@ -1,0 +1,54 @@
+#!/usr/bin/env python3
+"""Generate the 100 h training corpus (ROADMAP.md:50) as disk shards.
+
+    python scripts/gen_corpus.py --out datasets/corpus100            # 100 h
+    python scripts/gen_corpus.py --out /tmp/c4 --hours 4             # smoke
+
+~20 min and ~9 GB for the full corpus on one core; idempotent (an existing
+complete manifest short-circuits).  See nerrf_tpu/train/corpus.py for the
+layout and the training-side shard rotation.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--out", required=True)
+    ap.add_argument("--hours", type=float, default=100.0)
+    ap.add_argument("--duration-sec", type=float, default=600.0)
+    ap.add_argument("--benign-rate-hz", type=float, default=40.0)
+    ap.add_argument("--files", type=int, default=24)
+    ap.add_argument("--seed", type=int, default=1000)
+    ap.add_argument("--shard-windows", type=int, default=2000)
+    args = ap.parse_args(argv)
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")  # generation is host-only
+
+    from nerrf_tpu.train.corpus import CorpusSpec, generate_corpus
+
+    spec = CorpusSpec(
+        hours=args.hours,
+        duration_sec=args.duration_sec,
+        benign_rate_hz=args.benign_rate_hz,
+        num_target_files=args.files,
+        base_seed=args.seed,
+        shard_windows=args.shard_windows,
+    )
+    man = generate_corpus(args.out, spec,
+                          log=lambda m: print(f"[gen] {m}", flush=True))
+    print(f"[gen] manifest: {man['hours']:.1f}h "
+          f"{man['train_windows']}+{man['eval_windows']} windows")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
