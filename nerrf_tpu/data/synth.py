@@ -48,6 +48,22 @@ class SimConfig:
     # Benign workload intensity: mean syscall events per second across services.
     benign_rate_hz: float = 60.0
     seed: int = 0
+    # Adversarial/hard-negative scenario (VERDICT r1 item 5 — the quality
+    # gates mean little if the attack is linearly separable):
+    #   "standard"            — the default five-phase attack
+    #   "benign-mass-rename"  — NO attack; a backup archive job bulk-renames
+    #                           every target file (.dat → .dat.bak) with
+    #                           heavy reads/writes: the structural shape of
+    #                           ransomware with benign intent (FP-undo probe)
+    #   "slow-drip"           — attack spread across ~80% of the trace, one
+    #                           file at a time, aggregate rate far below any
+    #                           rate-limit detector
+    #   "benign-comm"         — attack runs under the SAME pid+comm as the
+    #                           benign python3 app worker, so identity
+    #                           features carry zero signal
+    #   "multi-process"       — attack sharded over 4 interleaved worker
+    #                           pids, each encrypting a subset concurrently
+    scenario: str = "standard"
 
 
 _BENIGN_SERVICES = (
@@ -183,12 +199,50 @@ def _emit_benign(em: _Emitter, cfg: SimConfig, rng: np.random.Generator, t0: int
                     new_path=f"/var/log/app/service_{idx}.log.1")
 
 
+def _emit_benign_mass_rename(em: _Emitter, cfg: SimConfig,
+                             rng: np.random.Generator, t0: int) -> None:
+    """Hard negative: a backup archive job sweeps the target directory —
+    open/read every file, write an archive copy, rename to .dat.bak — in one
+    tight burst.  Mass renames + extension change + high IO in the attack's
+    own directory, but benign (uid 0, no recon, reads-then-copies instead of
+    in-place overwrite).  This is what the <5% FP-undo KPI is measured on."""
+    pid = 208
+    comm = "backup-agent"
+    t = t0 + int(cfg.attack_start_sec * _NS)
+    names = _target_file_names(rng, cfg.num_target_files)
+    for nm in names:
+        src = f"{cfg.target_dir}/{nm}"
+        em.emit(t, Syscall.OPENAT, src, pid=pid, comm=comm, attack=False,
+                flags=int(OpenFlags.O_RDONLY))
+        t += int(rng.uniform(1, 5) * 1e6)
+        size = int(rng.integers(cfg.min_file_bytes, cfg.max_file_bytes))
+        for _ in range(max(1, size // cfg.chunk_bytes)):
+            em.emit(t, Syscall.READ, src, pid=pid, comm=comm, attack=False,
+                    nbytes=cfg.chunk_bytes)
+            t += int(rng.uniform(1, 3) * 1e6)
+            em.emit(t, Syscall.WRITE, f"/backup/archive/{nm}.gz", pid=pid,
+                    comm=comm, attack=False, nbytes=cfg.chunk_bytes // 2)
+            t += int(rng.uniform(1, 3) * 1e6)
+        em.emit(t, Syscall.RENAME, src, pid=pid, comm=comm, attack=False,
+                new_path=src + ".bak")
+        t += int(rng.uniform(2, 10) * 1e6)
+
+
 def _emit_attack(em: _Emitter, cfg: SimConfig, rng: np.random.Generator, t0: int) -> tuple[int, int]:
     """Five-phase LockBit-style attack; returns (start_ns, end_ns)."""
-    pid = 4567
+    if cfg.scenario == "multi-process":
+        return _emit_attack_multiprocess(em, cfg, rng, t0)
+    # benign-comm: reuse the benign python3 app worker's identity (pid 202,
+    # the pids[] entry _emit_benign uses), so comm/pid features are useless
+    pid = 202 if cfg.scenario == "benign-comm" else 4567
     comm = "python3"
     t = t0 + int(cfg.attack_start_sec * _NS)
     start = t
+    # slow-drip: spread file encryptions across most of the remaining trace
+    drip_gap_ns = 0
+    if cfg.scenario == "slow-drip":
+        window = (cfg.duration_sec - cfg.attack_start_sec) * 0.85 * _NS
+        drip_gap_ns = int(max(0.0, window) / max(cfg.num_target_files, 1))
 
     def step(lo_ms=2, hi_ms=40):
         nonlocal t
@@ -231,6 +285,7 @@ def _emit_attack(em: _Emitter, cfg: SimConfig, rng: np.random.Generator, t0: int
         # dst (no unlink — neither the reference simulator's rename-by-rewrite
         # endstate nor real LockBit leaves a deleted old name behind)
         em.emit(step(), Syscall.RENAME, src, pid=pid, comm=comm, attack=True, new_path=dst)
+        t += drip_gap_ns  # slow-drip: long quiet gap before the next file
 
     # P4 ransom note
     note = f"{cfg.target_dir}/README_LOCKBIT.txt"
@@ -241,6 +296,64 @@ def _emit_attack(em: _Emitter, cfg: SimConfig, rng: np.random.Generator, t0: int
     return start, t
 
 
+def _emit_attack_multiprocess(em: _Emitter, cfg: SimConfig,
+                              rng: np.random.Generator,
+                              t0: int) -> tuple[int, int]:
+    """The same five phases sharded over 4 worker pids whose encrypt loops
+    run concurrently — per-pid rates look 4× lower and file ordering
+    interleaves, defeating single-process burst heuristics."""
+    comm = "python3"
+    leader = 4567
+    workers = [4567, 4568, 4569, 4570]
+    t = t0 + int(cfg.attack_start_sec * _NS)
+    start = t
+
+    # leader does recon + discovery (as in the single-process path)
+    for p in ("/proc/self/status", "/proc/net/tcp", "/etc/passwd"):
+        for _ in range(int(rng.integers(2, 5))):
+            t += int(rng.uniform(2, 30) * 1e6)
+            em.emit(t, Syscall.OPENAT, p, pid=leader, comm=comm, attack=True,
+                    flags=int(OpenFlags.O_RDONLY))
+    names = _target_file_names(rng, cfg.num_target_files)
+    for nm in names:
+        t += int(rng.uniform(1, 4) * 1e6)
+        em.emit(t, Syscall.STAT, f"{cfg.target_dir}/{nm}", pid=leader,
+                comm=comm, attack=True)
+
+    # workers encrypt interleaved shards on independent clocks
+    cursors = {w: t + int(rng.uniform(5, 50) * 1e6) for w in workers}
+    for i, nm in enumerate(names):
+        w = workers[i % len(workers)]
+        tw = cursors[w]
+        src = f"{cfg.target_dir}/{nm}"
+        dst = (src[: -len(".dat")] + cfg.ransom_ext
+               if src.endswith(".dat") else src + cfg.ransom_ext)
+        size = int(rng.integers(cfg.min_file_bytes, cfg.max_file_bytes))
+        em.emit(tw, Syscall.OPENAT, src, pid=w, comm=comm, attack=True,
+                flags=int(OpenFlags.O_RDWR))
+        for _ in range(max(1, size // cfg.chunk_bytes)):
+            tw += int(rng.uniform(1, 3) * 1e6)
+            em.emit(tw, Syscall.READ, src, pid=w, comm=comm, attack=True,
+                    nbytes=cfg.chunk_bytes)
+            tw += int(rng.uniform(1, 3) * 1e6)
+            em.emit(tw, Syscall.WRITE, src, pid=w, comm=comm, attack=True,
+                    nbytes=cfg.chunk_bytes)
+            # each worker honors the rate limit independently (aggregate is
+            # 4× — fast attacks are the easy case; interleaving is the test)
+            tw += int(cfg.chunk_bytes / cfg.encrypt_rate_bps * 1e9)
+        tw += int(rng.uniform(2, 10) * 1e6)
+        em.emit(tw, Syscall.RENAME, src, pid=w, comm=comm, attack=True,
+                new_path=dst)
+        cursors[w] = tw
+    end = max(cursors.values())
+    note = f"{cfg.target_dir}/README_LOCKBIT.txt"
+    em.emit(end + int(1e7), Syscall.OPENAT, note, pid=leader, comm=comm,
+            attack=True, flags=int(OpenFlags.O_WRONLY))
+    em.emit(end + int(2e7), Syscall.WRITE, note, pid=leader, comm=comm,
+            attack=True, nbytes=1337)
+    return start, end + int(2e7)
+
+
 def simulate_trace(cfg: SimConfig, name: str = "") -> Trace:
     """Generate one labelled trace."""
     rng = np.random.default_rng(cfg.seed)
@@ -249,7 +362,10 @@ def simulate_trace(cfg: SimConfig, name: str = "") -> Trace:
     t0 = 1_700_000_000 * _NS + int(cfg.seed) * 10_000 * _NS
     _emit_benign(em, cfg, rng, t0)
     gt = None
-    if cfg.attack:
+    if cfg.scenario == "benign-mass-rename":
+        # hard negative: structurally attack-like, labelled benign throughout
+        _emit_benign_mass_rename(em, cfg, rng, t0)
+    elif cfg.attack:
         start, end = _emit_attack(em, cfg, rng, t0)
         gt = GroundTruth(
             start_ns=start,

@@ -1,4 +1,10 @@
-from nerrf_tpu.parallel.mesh import MeshConfig, make_mesh, batch_sharding, param_sharding
+from nerrf_tpu.parallel.mesh import (
+    MeshConfig,
+    make_mesh,
+    batch_sharding,
+    param_sharding,
+    init_distributed,
+)
 from nerrf_tpu.parallel.train import (
     make_sharded_train_step,
     shard_batch,
@@ -13,6 +19,7 @@ __all__ = [
     "make_mesh",
     "batch_sharding",
     "param_sharding",
+    "init_distributed",
     "make_sharded_train_step",
     "shard_batch",
     "init_sharded_state",

@@ -49,6 +49,45 @@ class MeshConfig:
         return dp, tp, sp
 
 
+def init_distributed(
+    coordinator: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    local_device_ids: Optional[Sequence[int]] = None,
+) -> bool:
+    """Join a multi-host JAX cluster (the reference spec's cross-node deploy,
+    `architecture.mdx:165-189`, done the TPU way: one controller process per
+    host, `jax.distributed.initialize`, then every `jax.devices()` call sees
+    the global device set and GSPMD collectives ride ICI/DCN).
+
+    Explicit args, or environment:
+        NERRF_COORDINATOR   host:port of process 0
+        NERRF_NUM_PROCESSES total processes
+        NERRF_PROCESS_ID    this process's rank
+    On managed TPU pods (GKE/queued resources) all three resolve
+    automatically — call with no args and jax autodetects.  Returns True if
+    distributed init ran, False if single-process (no config present).
+    MUST run before first backend use (any jit / jax.devices()).
+    """
+    import os
+
+    coordinator = coordinator or os.environ.get("NERRF_COORDINATOR")
+    if num_processes is None:
+        num_processes = int(os.environ.get("NERRF_NUM_PROCESSES", 0)) or None
+    if process_id is None:
+        pid = os.environ.get("NERRF_PROCESS_ID")
+        process_id = int(pid) if pid is not None else None
+    if coordinator is None and num_processes is None:
+        return False
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+        local_device_ids=local_device_ids,
+    )
+    return True
+
+
 def make_mesh(
     cfg: Optional[MeshConfig] = None, devices: Optional[Sequence[jax.Device]] = None
 ) -> Mesh:

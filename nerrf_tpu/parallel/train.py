@@ -38,9 +38,24 @@ def _loop():
 
 
 def shard_batch(mesh: Mesh, batch: Dict[str, np.ndarray]) -> Dict[str, jax.Array]:
-    """Place a host batch onto the mesh, window axis split over dp."""
+    """Place a host batch onto the mesh, window axis split over dp.
+
+    Works in both deployment shapes:
+      * single process (one host, N local devices): plain sharded device_put;
+      * multi-process (one controller per host, global mesh): every process
+        must call this with the IDENTICAL global batch (derive it from a
+        shared seed — run.py does); `make_array_from_callback` then uploads
+        only the rows owned by this process's addressable devices, and the
+        result is one global jax.Array spanning hosts.
+    """
     sh = batch_sharding(mesh)
-    return {k: jax.device_put(jnp.asarray(v), sh) for k, v in batch.items()}
+    if jax.process_count() == 1:
+        return {k: jax.device_put(jnp.asarray(v), sh) for k, v in batch.items()}
+    return {
+        k: jax.make_array_from_callback(
+            np.asarray(v).shape, sh, lambda idx, v=np.asarray(v): v[idx])
+        for k, v in batch.items()
+    }
 
 
 def init_sharded_state(

@@ -123,10 +123,18 @@ def run_experiment(name_or_path: str, out_dir: str | Path,
         jax.block_until_ready(state.params)
         steps_per_sec = (cfg.num_steps - 1) / max(
             time.perf_counter() - (t_start or 0), 1e-9)
-        from nerrf_tpu.train.loop import evaluate, make_eval_fn
+        if jax.process_count() > 1:
+            # host-side eval pulls full arrays, which only exists per-process
+            # in a multi-controller run; report the (replicated) final loss
+            # and leave ranked eval to a single-process job on the checkpoint
+            _log("multi-process run: reporting final loss; run eval "
+                 "single-process from the saved checkpoint")
+            metrics = {"final_loss": float(np.asarray(jax.device_get(loss)))}
+        else:
+            from nerrf_tpu.train.loop import evaluate, make_eval_fn
 
-        metrics = evaluate(make_eval_fn(model), state.params,
-                           eval_ds or train_ds, cfg.batch_size)
+            metrics = evaluate(make_eval_fn(model), state.params,
+                               eval_ds or train_ds, cfg.batch_size)
         params = state.params
     elif ckpt_every > 0:
         from nerrf_tpu.train.elastic import train_elastic
@@ -189,6 +197,16 @@ def main(argv=None) -> int:
     ap.add_argument("--ckpt-every", type=int, default=0,
                     help="elastic full-state checkpoints every N steps")
     args = ap.parse_args(argv)
+    # Multi-host: join the cluster BEFORE any backend use.  Set
+    # NERRF_COORDINATOR/NERRF_NUM_PROCESSES/NERRF_PROCESS_ID per process
+    # (architecture.mdx:165-189's cross-node deploy, the jax way).
+    from nerrf_tpu.parallel import init_distributed
+
+    if init_distributed():
+        import jax
+
+        _log(f"distributed: process {jax.process_index()}/"
+             f"{jax.process_count()}, {jax.device_count()} global devices")
     report = run_experiment(args.experiment, args.out, args.steps,
                             args.ckpt_every)
     return 0 if all(report["gates"].values()) else 1

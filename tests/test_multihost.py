@@ -1,0 +1,110 @@
+"""Multi-host training path: 2 controller processes × 4 virtual CPU devices
+each, one global 8-device mesh, sharded train steps across the process
+boundary (VERDICT r1 item 6; the reference spec's cross-node deploy,
+architecture.mdx:165-189, done as jax.distributed + GSPMD)."""
+
+import socket
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+
+WORKER = r"""
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+port, rank = sys.argv[1], int(sys.argv[2])
+
+from nerrf_tpu.parallel import (
+    MeshConfig, init_distributed, init_sharded_state, make_mesh,
+    make_sharded_train_step, shard_batch,
+)
+
+init_distributed(f"localhost:{port}", num_processes=2, process_id=rank)
+assert jax.process_count() == 2, jax.process_count()
+assert jax.device_count() == 8, jax.device_count()
+assert len(jax.local_devices()) == 4
+
+import numpy as np
+
+from nerrf_tpu.data import make_corpus
+from nerrf_tpu.models import JointConfig, NerrfNet
+from nerrf_tpu.train import TrainConfig, build_dataset
+
+# both ranks derive the IDENTICAL dataset + batch order from shared seeds;
+# shard_batch then uploads only locally-owned rows
+corpus = make_corpus(2, attack_fraction=1.0, base_seed=9, duration_sec=60.0,
+                     num_target_files=5, benign_rate_hz=5.0)
+ds = build_dataset(corpus)
+from nerrf_tpu.models.graphsage import GraphSAGEConfig
+from nerrf_tpu.models.lstm import LSTMConfig
+
+# tiniest viable joint model: the test proves cross-process SPMD, and the
+# two ranks share one physical core — compile time is the whole budget
+tiny = JointConfig(gnn=GraphSAGEConfig(hidden=32, num_layers=2),
+                   lstm=LSTMConfig(hidden=32, num_layers=1))
+cfg = TrainConfig(model=tiny, batch_size=8, num_steps=2)
+mesh = make_mesh(MeshConfig(dp=4, tp=2, sp=1))
+model = NerrfNet(cfg.model)
+state = init_sharded_state(model, cfg, ds.arrays, mesh)
+step = make_sharded_train_step(model, cfg, mesh)
+rng = jax.random.PRNGKey(0)
+order = np.random.default_rng(0)
+loss = None
+for _ in range(cfg.num_steps):
+    idx = order.choice(len(ds), size=cfg.batch_size, replace=True)
+    batch = shard_batch(mesh, {k: v[idx] for k, v in ds.arrays.items()})
+    state, loss, aux, rng = step(state, batch, rng)
+jax.block_until_ready(loss)
+print(f"FINAL_LOSS {float(np.asarray(jax.device_get(loss))):.6f}", flush=True)
+"""
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.mark.slow
+def test_two_process_sharded_training(tmp_path):
+    script = tmp_path / "worker.py"
+    script.write_text(WORKER)
+    port = _free_port()
+
+    def spawn(rank: int):
+        import os
+
+        env = dict(os.environ)
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+        env.pop("JAX_PLATFORMS", None)
+        env["PYTHONPATH"] = f"{REPO}:{env.get('PYTHONPATH', '')}"
+        return subprocess.Popen(
+            [sys.executable, str(script), str(port), str(rank)],
+            env=env, cwd=REPO, stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, text=True,
+        )
+
+    procs = [spawn(0), spawn(1)]
+    outs = []
+    for p in procs:
+        out, err = p.communicate(timeout=900)
+        assert p.returncode == 0, f"rank failed:\n{err[-2000:]}"
+        outs.append(out)
+
+    losses = []
+    for out in outs:
+        lines = [ln for ln in out.splitlines() if ln.startswith("FINAL_LOSS")]
+        assert lines, out
+        losses.append(float(lines[-1].split()[1]))
+    # both controllers hold the same replicated loss — the global step ran
+    # across the process boundary, not two disjoint runs
+    assert losses[0] == pytest.approx(losses[1], abs=1e-5), losses
