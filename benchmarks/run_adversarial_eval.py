@@ -58,20 +58,27 @@ def _scenario_traces(scenario: str, n: int, seed: int):
     return traces
 
 
-def _attacked_files(trace) -> set:
-    """Ground truth at file granularity: paths renamed to the ransom ext by
-    a labelled-attack event."""
+def _attacked_files(trace) -> tuple[set, set]:
+    """File-granular ground truth from per-event labels:
+    (encrypted, attack_touched) — `encrypted` are the ransom-renamed
+    victims (detection-rate denominator); `attack_touched` additionally
+    includes every path an attack event wrote/renamed (ransom note, the
+    pre-rename names), so flagging those does not count as a false undo."""
     ev, st = trace.events, trace.strings
-    out = set()
+    encrypted, touched = set(), set()
     if trace.labels is None:
-        return out
+        return encrypted, touched
     for i in range(len(ev)):
         if not ev.valid[i] or trace.labels[i] < 0.5:
             continue
+        path = st.lookup(int(ev.path_id[i]))
         new = st.lookup(int(ev.new_path_id[i]))
         if new.endswith(".lockbit3"):
-            out.add(new)
-    return out
+            encrypted.add(new)
+        for p in (path, new):
+            if p:
+                touched.add(p)
+    return encrypted, touched
 
 
 def _benign_touched_files(trace) -> set:
@@ -98,12 +105,12 @@ def _file_metrics(traces, detect) -> dict:
     for tr in traces:
         det = detect(tr)
         flagged = set(det.flagged_files(0.5))
-        attacked = _attacked_files(tr)
-        attacked_total += len(attacked)
+        encrypted, touched = _attacked_files(tr)
+        attacked_total += len(encrypted)
         flagged_total += len(flagged)
-        tp += len(flagged & attacked)
+        tp += len(flagged & encrypted)
         # an undo of a file the attack never touched reverts legitimate work
-        fp += len(flagged - attacked)
+        fp += len(flagged - touched)
     return {
         "files_attacked": attacked_total,
         "files_flagged": flagged_total,

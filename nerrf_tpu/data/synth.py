@@ -91,7 +91,6 @@ class _Emitter:
     def __init__(self):
         self.records: list[dict] = []
         self.labels: list[float] = []
-        self._inodes = InodeTable()
 
     def emit(
         self,
@@ -108,11 +107,10 @@ class _Emitter:
         uid: int = 0,
         ret_val: int = 0,
     ) -> None:
-        inode = (
-            self._inodes.carry_rename(path, new_path)
-            if new_path
-            else self._inodes.get(path)
-        )
+        # inode is assigned later, in TIME order (simulate_trace): the benign
+        # and attack streams are emitted sequentially, so assigning here
+        # would let a post-rename benign open of the old name alias the
+        # renamed file's inode (emission order ≠ causal order)
         self.records.append(
             {
                 "ts_ns": ts_ns,
@@ -125,7 +123,7 @@ class _Emitter:
                 "flags": flags,
                 "ret_val": ret_val,
                 "bytes": nbytes,
-                "inode": inode,
+                "inode": 0,
                 "uid": uid,
             }
         )
@@ -375,14 +373,25 @@ def simulate_trace(cfg: SimConfig, name: str = "") -> Trace:
             platform="synthetic",
             scale=f"{cfg.num_target_files}f",
         )
-    events = EventArrays.from_records(em.records, strings)
-    labels = np.asarray(em.labels, np.float32)
-    order = np.argsort(events.ts_ns, kind="stable")
+    # sort by time FIRST, then assign inodes walking causally: a rename
+    # invalidates its source name, so later opens of it get a fresh inode
+    order = sorted(range(len(em.records)), key=lambda i: em.records[i]["ts_ns"])
+    inodes = InodeTable()
+    recs = []
+    for i in order:
+        r = em.records[i]
+        r["inode"] = (
+            inodes.carry_rename(r["path"], r["new_path"])
+            if r["new_path"] else inodes.get(r["path"])
+        )
+        recs.append(r)
+    events = EventArrays.from_records(recs, strings)
+    labels = np.asarray([em.labels[i] for i in order], np.float32)
     return Trace(
-        events=events.take(order),
+        events=events,
         strings=strings,
         ground_truth=gt,
-        labels=labels[order],
+        labels=labels,
         name=name or f"synth-seed{cfg.seed}",
     )
 

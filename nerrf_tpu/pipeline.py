@@ -137,8 +137,12 @@ def model_detect(
     file_bytes: Dict[str, float] = {}
     for i in range(0, len(samples), batch_size):
         chunk = samples[i : i + batch_size]
-        batch = {
-            k: jnp.asarray(np.stack([s[k] for s in chunk]))
+        pad = batch_size - len(chunk)  # fixed batch shape: a ragged tail
+        batch = {                      # would recompile eval per trace size
+            k: jnp.asarray(np.concatenate(
+                [np.stack([s[k] for s in chunk])]
+                + ([np.zeros((pad,) + chunk[0][k].shape,
+                             chunk[0][k].dtype)] if pad else [])))
             for k in chunk[0]
         }
         out = jax.device_get(eval_fn(params, batch))

@@ -176,10 +176,16 @@ class InodeTable:
         return got
 
     def carry_rename(self, src: str, dst: str) -> int:
-        """Record src→dst rename; returns the carried inode."""
+        """Record src→dst rename; returns the carried inode.  The src name
+        is invalidated (POSIX: it no longer refers to any file), so a later
+        open of the old name allocates a fresh inode — without this, benign
+        re-touches of a renamed path would alias the renamed file's node and
+        steal its identity in inode→path maps (pipeline attribution bug)."""
         ino = self.get(src)
         if dst:
             self._of[dst] = ino
+            if src in self._of:
+                del self._of[src]
         return ino
 
     def register(self, path: str, inode: int, new_path: str = "") -> None:
