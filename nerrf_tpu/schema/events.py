@@ -184,7 +184,9 @@ class InodeTable:
         ino = self.get(src)
         if dst:
             self._of[dst] = ino
-            if src in self._of:
+            # src != dst guard: rename(a, a) is a legal no-op — deleting the
+            # mapping would split one file into two synthetic identities
+            if src != dst and src in self._of:
                 del self._of[src]
         return ino
 

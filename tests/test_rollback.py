@@ -175,3 +175,56 @@ def test_pipeline_detect_and_domain(tmp_path):
                        MCTSConfig(num_simulations=200, batch_size=16)).plan()
     targets = {a.target for a in plan.actions}
     assert {str(e) for e in encrypted} <= targets
+
+
+def test_gate_replay_validates_determinism(tmp_path):
+    """clone → REPLAY → rehearse (architecture.mdx:75-87): when the captured
+    trace fully explains the observed damage, the gate approves."""
+    victim = tmp_path / "v"
+    seed_files(victim, CFG)
+    store = SnapshotStore(tmp_path / "store")
+    m = store.snapshot(victim, "pre")
+    trace, encrypted = run_file_attack(victim, CFG)
+
+    gate = SandboxGate(store, m).rehearse(_plan_for(encrypted), victim,
+                                          trace=trace)
+    assert gate.replay_ops > 0
+    assert gate.replay_divergence == {}
+    assert gate.approved, gate.reason
+    assert "replay deterministic" in gate.reason
+
+
+def test_gate_replay_catches_nondeterministic_side_effect(tmp_path):
+    """An attacker action the trace does NOT capture (here: an extra file
+    deleted after capture) must fail the gate — an undo plan validated
+    against an incomplete story cannot be trusted."""
+    victim = tmp_path / "v"
+    seed_files(victim, CFG)
+    store = SnapshotStore(tmp_path / "store")
+    m = store.snapshot(victim, "pre")
+    trace, encrypted = run_file_attack(victim, CFG)
+    # off-trace side effect: one encrypted artifact vanishes untraced
+    encrypted[0].unlink()
+
+    gate = SandboxGate(store, m).rehearse(_plan_for(encrypted[1:]), victim,
+                                          trace=trace)
+    assert not gate.approved
+    assert gate.replay_divergence, "divergence should have been detected"
+    assert any("missing-from-victim" in v
+               for v in gate.replay_divergence.values())
+
+
+def test_gate_replay_catches_uncaptured_write(tmp_path):
+    """A file the attacker wrote without the tracer seeing it (trace cannot
+    reproduce it) is flagged as unexplained."""
+    victim = tmp_path / "v"
+    seed_files(victim, CFG)
+    store = SnapshotStore(tmp_path / "store")
+    m = store.snapshot(victim, "pre")
+    trace, encrypted = run_file_attack(victim, CFG)
+    (victim / "exfil_staging.bin").write_bytes(b"Z" * 512)  # untraced write
+
+    gate = SandboxGate(store, m).rehearse(_plan_for(encrypted), victim,
+                                          trace=trace)
+    assert not gate.approved
+    assert gate.replay_divergence.get("exfil_staging.bin") == "unexplained-by-trace"
