@@ -88,12 +88,57 @@ EDGE_FEATURE_DIM = 13
 class GraphConfig:
     """Window + capacity knobs.  Defaults: 45 s window / 15 s stride (inside
     the spec's 30–60 s band), capacities sized ~4× the M1 scale (45-50 files +
-    a handful of processes) so padding dominates only mildly."""
+    a handful of processes) so padding dominates only mildly.
+
+    Capacity guidance (measured, benchmarks/run_graph_capacity.py): the
+    defaults fit the synthetic training corpus (40 Hz benign load) with zero
+    drops, but a ~25 k-event window at projected real-eBPF density
+    (threat-model.mdx:121-137, ≈550 evt/s) needs ~3.2 k nodes / 4.4 k edges —
+    at the defaults ~34 % of events drop.  Online paths at real density
+    should use :meth:`fit` (exact count → power-of-two bucket), which bounds
+    XLA recompiles to the handful of bucket shapes."""
 
     window_sec: float = 45.0
     stride_sec: float = 15.0
     max_nodes: int = 256
     max_edges: int = 512
+
+    def fit(self, events: "EventArrays", lo_ns: int, hi_ns: int,
+            headroom: float = 1.25) -> "GraphConfig":
+        """Capacities sized to THIS window's exact node/edge need (×headroom,
+        rounded up to a power of two, floored at the defaults)."""
+        n_nodes, n_edges = measure_window(events, lo_ns, hi_ns)
+
+        def bucket(need: int, floor: int) -> int:
+            need = max(int(np.ceil(need * headroom)), floor)
+            return 1 << int(np.ceil(np.log2(need)))
+
+        return dataclasses.replace(
+            self,
+            max_nodes=bucket(n_nodes, self.max_nodes),
+            max_edges=bucket(n_edges, self.max_edges),
+        )
+
+
+def measure_window(events: "EventArrays", lo_ns: int, hi_ns: int) -> Tuple[int, int]:
+    """Exact (num_nodes, num_edges) a window needs for zero-drop lowering:
+    nodes = unique processes + unique file inodes, edges = unique
+    (process, file) pairs — the same universe build_window_graph constructs,
+    counted vectorized without building anything."""
+    sel = (
+        events.valid
+        & (events.ts_ns >= lo_ns)
+        & (events.ts_ns < hi_ns)
+        & (events.syscall != int(Syscall.MARKER))
+    )
+    pid = events.pid[sel].astype(np.int64)
+    inode = events.inode[sel]
+    has_file = inode > 0
+    n_nodes = len(np.unique(pid)) + len(np.unique(inode[has_file]))
+    pairs = np.stack(
+        [pid[has_file], inode[has_file].astype(np.int64)], axis=1)
+    n_edges = len(np.unique(pairs, axis=0)) if len(pairs) else 0
+    return n_nodes, n_edges
 
 
 @dataclasses.dataclass

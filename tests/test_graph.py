@@ -155,3 +155,48 @@ def test_rename_is_node_property_not_new_node():
     # the file carries both the rename count and the suspicious-ext flag
     assert g.node_feat[files, 10] > 0
     assert g.node_feat[files, 4].max() == 1.0
+
+
+def test_measure_window_matches_builder_exactly():
+    """measure_window's vectorized count must equal what build_window_graph
+    actually constructs when nothing is dropped (same node/edge universe)."""
+    from nerrf_tpu.data.synth import SimConfig, simulate_trace
+    from nerrf_tpu.graph.builder import (
+        GraphConfig, build_window_graph, measure_window,
+    )
+
+    tr = simulate_trace(SimConfig(duration_sec=60.0, benign_rate_hz=30.0,
+                                  num_target_files=10, attack=True,
+                                  attack_start_sec=20.0, seed=11))
+    ev = tr.events
+    lo = int(ev.ts_ns[ev.valid].min())
+    hi = lo + 45 * 10**9
+    need_n, need_e = measure_window(ev, lo, hi)
+    g, stats = build_window_graph(
+        ev, tr.strings, lo, hi,
+        GraphConfig(max_nodes=4 * need_n, max_edges=4 * need_e))
+    assert stats.dropped_nodes == 0 and stats.dropped_events == 0
+    assert stats.num_nodes == need_n
+    assert stats.num_edges == need_e
+
+
+def test_graphconfig_fit_gives_zero_drops_at_high_density():
+    """The auto-sizing policy: 25k-event windows (real-eBPF density) drop a
+    third of their events at training defaults; fit() must eliminate that."""
+    from nerrf_tpu.data.synth import SimConfig, simulate_trace
+    from nerrf_tpu.graph.builder import GraphConfig, build_window_graph
+
+    tr = simulate_trace(SimConfig(duration_sec=50.0, benign_rate_hz=400.0,
+                                  num_target_files=30, attack=True,
+                                  attack_start_sec=10.0, seed=12))
+    ev = tr.events
+    lo = int(ev.ts_ns[ev.valid].min())
+    hi = lo + 45 * 10**9
+    base = GraphConfig()
+    _, base_stats = build_window_graph(ev, tr.strings, lo, hi, base)
+    assert base_stats.dropped_events > 0  # defaults overflow at this density
+
+    fit = base.fit(ev, lo, hi)
+    assert fit.max_nodes >= base.max_nodes and (fit.max_nodes & (fit.max_nodes - 1)) == 0
+    _, stats = build_window_graph(ev, tr.strings, lo, hi, fit)
+    assert stats.dropped_nodes == 0 and stats.dropped_events == 0
