@@ -25,11 +25,12 @@ from nerrf_tpu.planner.value_net import HeuristicValue, ValueFn
 class MCTSConfig:
     num_simulations: int = 800          # spec band: 500–1000
     # Frontier leaves per device dispatch.  Each dispatch pays a fixed
-    # host→device round trip (large over a remote tunnel), so bigger batches
-    # amortize it: measured on TPU (M1-scale domain, 800 sims) 32→303,
-    # 64→530, 128→692 rollouts/s, all yielding identical plans (virtual loss
-    # keeps concurrent selections diverse).  64 is the default to stay
-    # conservative on small action spaces; bench.py uses 128.
+    # host→device round trip (large over a remote tunnel); bigger batches
+    # amortize it, and since r2 the dispatch is double-buffered — the host
+    # selects/expands frontier i+1 while batch i's values are in flight —
+    # so the round trip overlaps host work instead of serializing with it.
+    # 64 stays the default to stay conservative on small action spaces;
+    # bench.py uses 128 (the benchmark of record tracks rollouts/s there).
     batch_size: int = 64
     c_puct: float = 1.5
     virtual_loss: float = 3.0
@@ -130,22 +131,38 @@ class MCTSPlanner:
         root = self._new_node(self.d.initial_state(), -1, -1)
         self.expanded[root] = True
         sims = 0
-        while sims < cfg.num_simulations:
-            if time.perf_counter() - t0 > cfg.timeout_seconds:
-                break
-            # collect a frontier batch under virtual loss
+        # async double-buffered dispatch: while frontier batch i's values are
+        # in flight on the device, the host selects/expands batch i+1 (its
+        # virtual losses from batch i are still applied, so the two batches
+        # explore disjoint leaves).  ValueFns exposing `submit` return the
+        # un-synced device array; plain callables degrade to synchronous.
+        submit = getattr(self.value_fn, "submit", self.value_fn)
+        issued = 0
+        pending: Optional[tuple[list, object]] = None
+
+        def collect() -> Optional[tuple[list, object]]:
+            nonlocal issued
+            want = min(cfg.batch_size, cfg.num_simulations - issued)
+            if want <= 0:
+                return None
             frontier: list[tuple[int, list[int]]] = []
-            for _ in range(min(cfg.batch_size, cfg.num_simulations - sims)):
+            for _ in range(want):
                 leaf, path = self._select_leaf()
                 for n in path:
                     self.vloss[n] += 1
                 frontier.append((leaf, path))
-            # device dispatch: value-net on the whole frontier at once
+            issued += len(frontier)
             feats = self.d.value_features(
                 np.stack([self.state[leaf] for leaf, _ in frontier])
             )
-            values = self.value_fn(feats)
-            terminal = np.array([self.is_terminal[leaf] for leaf, _ in frontier])
+            return frontier, submit(feats)
+
+        def resolve(batch: tuple[list, object]) -> None:
+            nonlocal sims
+            frontier, fut = batch
+            values = np.asarray(fut)  # sync point (device round trip)
+            terminal = np.array(
+                [self.is_terminal[leaf] for leaf, _ in frontier])
             values = np.where(terminal, 0.0, values)
             for (leaf, path), v in zip(frontier, values):
                 for n in path:
@@ -153,6 +170,15 @@ class MCTSPlanner:
                 self.expanded[leaf] = True
                 self._backup(path, float(v))
                 sims += 1
+
+        pending = collect()
+        while pending is not None:
+            if time.perf_counter() - t0 > cfg.timeout_seconds:
+                resolve(pending)
+                break
+            nxt = collect()   # overlaps with pending's device eval
+            resolve(pending)
+            pending = nxt
         elapsed = time.perf_counter() - t0
 
         # --- extract ranked plan ---------------------------------------------

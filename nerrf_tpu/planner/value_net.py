@@ -77,6 +77,12 @@ class ValueNet:
     def __call__(self, features: np.ndarray) -> np.ndarray:
         return np.asarray(self._apply(self.params, jnp.asarray(features)))
 
+    def submit(self, features: np.ndarray):
+        """Async dispatch: returns the un-synced device array so the caller
+        can overlap host work (MCTS select/expand of the NEXT frontier) with
+        the device round trip; resolve with np.asarray(result)."""
+        return self._apply(self.params, jnp.asarray(features))
+
     def fit_to_domain(
         self,
         domain: UndoDomain,
