@@ -50,10 +50,22 @@ def _module(mod):
 
 
 def _jax_backend():
-    import jax
-
-    devices = jax.devices()
-    return f"{jax.default_backend()} x{len(devices)} ({devices[0].device_kind})"
+    # Probe in a bounded subprocess: a dead accelerator tunnel makes
+    # jax.devices() block forever in-process, and a doctor that hangs is
+    # worse than a failing check.
+    code = ("import jax; d = jax.devices(); "
+            "print(f'{jax.default_backend()} x{len(d)} ({d[0].device_kind})')")
+    try:
+        r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                           text=True, timeout=75)
+    except subprocess.TimeoutExpired:
+        raise TimeoutError(
+            "backend init did not respond in 75s (accelerator tunnel down?) "
+            "— CPU fallback: jax.config.update('jax_platforms', 'cpu')")
+    if r.returncode != 0:
+        raise RuntimeError(r.stderr.strip().splitlines()[-1][:200]
+                           if r.stderr.strip() else f"rc={r.returncode}")
+    return r.stdout.strip()
 
 
 def _toolchain(tool):
@@ -125,6 +137,21 @@ def main() -> int:
     rows.append(check("native:libraries", _native_libs))
     rows.append(check("native:bpf-target", _bpf_target, required=False))
     rows.append(check("sandbox:kvm+firecracker", _kvm, required=False))
+
+    def _capture_probe():
+        daemon = os.path.join(REPO, "native", "build", "nerrf-trackerd")
+        if not os.path.exists(daemon):
+            raise FileNotFoundError("nerrf-trackerd not built (make -C native)")
+        r = subprocess.run([daemon, "--probe"], capture_output=True, text=True,
+                           timeout=30)
+        if r.returncode == 0:
+            return "live kernel capture available"
+        raise PermissionError(
+            {2: "no CAP_BPF (replay mode still works)",
+             3: "kernel support missing (replay mode still works)"}.get(
+                r.returncode, f"probe rc={r.returncode}"))
+
+    rows.append(check("capture:live-bpf", _capture_probe, required=False))
 
     ok = all(r["ok"] for r in rows if r["required"])
     if "--json" in sys.argv:

@@ -1,0 +1,141 @@
+#!/usr/bin/env bash
+# Dependency installer: the "treat" counterpart of scripts/check_env.py's
+# doctor — capability parity with the reference's distro installer
+# (`/root/reference/tracker/scripts/install-deps.sh`: toolchain, kernel
+# config verification, BPF filesystem), retargeted at this framework's needs:
+#
+#   * native toolchain (g++, make) for native/ (ingest, trace store,
+#     capture daemon — which needs NO clang/libbpf: it assembles its eBPF
+#     bytecode at load time, src/capture.cc)
+#   * python stack (jax/flax/optax/orbax/grpcio/numpy) via pip
+#   * kernel capability check + tracefs mount for live capture
+#   * builds the native components and runs the doctor
+#
+# Modes:
+#   ./install-deps.sh            install missing pieces (needs root for apt/
+#                                mount steps; skips them gracefully otherwise)
+#   ./install-deps.sh --check    report-only (no mutation; CI-safe)
+set -u
+
+CHECK_ONLY=0
+[ "${1:-}" = "--check" ] && CHECK_ONLY=1
+REPO="$(cd "$(dirname "$0")/.." && pwd)"
+FAIL=0
+
+say()  { printf '%s\n' "$*"; }
+ok()   { say "  [ok]   $*"; }
+warn() { say "  [warn] $*"; }
+bad()  { say "  [FAIL] $*"; FAIL=1; }
+
+have() { command -v "$1" >/dev/null 2>&1; }
+
+as_root() {  # run a mutation as root if possible, else report
+    if [ "$CHECK_ONLY" = 1 ]; then
+        warn "would run: $*"
+        return 1
+    fi
+    if [ "$(id -u)" = 0 ]; then "$@"; return $?; fi
+    if have sudo; then sudo "$@"; return $?; fi
+    warn "not root and no sudo — cannot run: $*"
+    return 1
+}
+
+say "== distro detection"
+DISTRO=unknown
+if [ -r /etc/os-release ]; then
+    . /etc/os-release
+    DISTRO="${ID:-unknown}"
+fi
+ok "distro: $DISTRO ($(uname -r))"
+
+say "== native toolchain"
+PKGS=""
+for tool in g++ make; do
+    if have "$tool"; then ok "$tool: $(command -v "$tool")"; else
+        PKGS="$PKGS $tool"
+    fi
+done
+if [ -n "$PKGS" ]; then
+    case "$DISTRO" in
+        debian|ubuntu) as_root apt-get install -y build-essential && ok "installed build-essential" || bad "toolchain missing:$PKGS" ;;
+        fedora|rhel|centos) as_root dnf install -y gcc-c++ make && ok "installed gcc-c++" || bad "toolchain missing:$PKGS" ;;
+        *) bad "toolchain missing:$PKGS (unknown distro — install g++/make manually)" ;;
+    esac
+fi
+
+say "== python stack"
+PY_MISSING=$(python3 - <<'EOF'
+import importlib
+need = ["jax", "flax", "optax", "orbax.checkpoint", "numpy", "grpc",
+        "google.protobuf"]
+missing = []
+for m in need:
+    try:
+        importlib.import_module(m)
+    except Exception:
+        missing.append(m)
+print(" ".join(missing))
+EOF
+)
+if [ -z "$PY_MISSING" ]; then
+    ok "python deps present"
+else
+    warn "missing python modules: $PY_MISSING"
+    if [ "$CHECK_ONLY" = 1 ]; then
+        warn "would run: pip install jax flax optax orbax-checkpoint grpcio protobuf numpy"
+    else
+        python3 -m pip install jax flax optax orbax-checkpoint grpcio protobuf numpy \
+            && ok "pip install done" || bad "pip install failed"
+    fi
+fi
+
+say "== kernel capability for live capture"
+if [ -r /proc/config.gz ] && have zcat; then
+    for opt in CONFIG_BPF=y CONFIG_BPF_SYSCALL=y CONFIG_TRACEPOINTS=y; do
+        if zcat /proc/config.gz | grep -q "^$opt"; then ok "$opt"; else warn "$opt not set"; fi
+    done
+else
+    warn "/proc/config.gz unavailable — relying on runtime probe"
+fi
+if [ -d /sys/kernel/tracing/events/raw_syscalls ] || \
+   [ -d /sys/kernel/debug/tracing/events/raw_syscalls ]; then
+    ok "tracefs mounted (raw_syscalls visible)"
+else
+    warn "tracefs not mounted"
+    if as_root mount -t tracefs tracefs /sys/kernel/tracing 2>/dev/null; then
+        ok "mounted tracefs at /sys/kernel/tracing"
+    else
+        warn "could not mount tracefs (live capture will probe+skip)"
+    fi
+fi
+
+say "== native build"
+if [ "$CHECK_ONLY" = 1 ]; then
+    if [ -x "$REPO/native/build/nerrf-trackerd" ]; then
+        ok "native artifacts present"
+    else
+        warn "native artifacts not built (would run: make -C native)"
+    fi
+else
+    make -C "$REPO/native" >/dev/null && ok "native components built" \
+        || bad "native build failed"
+fi
+
+say "== capture probe"
+if [ -x "$REPO/native/build/nerrf-trackerd" ]; then
+    "$REPO/native/build/nerrf-trackerd" --probe >/dev/null 2>&1
+    rc=$?
+    case "$rc" in
+        0) ok "live capture available" ;;
+        2) warn "live capture: no permission (CAP_BPF) — replay mode still works" ;;
+        3) warn "live capture: kernel support missing — replay mode still works" ;;
+        *) warn "capture probe rc=$rc" ;;
+    esac
+else
+    warn "daemon not built — probe skipped"
+fi
+
+say "== doctor"
+python3 "$REPO/scripts/check_env.py" || FAIL=1
+
+exit "$FAIL"
