@@ -1,0 +1,243 @@
+#!/usr/bin/env python3
+"""Static docs-site builder: docs/*.md → docs/site/*.html, zero dependencies.
+
+The reference ships a ~3.1k-line Next.js/fumadocs site (`docs/package.json`);
+its *capability* is a browsable, navigable HTML rendering of the guides.
+This builder produces that surface from the same markdown with nothing but
+the stdlib — no node, no npm, no network — which is the right weight for an
+infra repo: the content is the product, the chrome is 200 lines.
+
+    python scripts/build_docs.py            # writes docs/site/
+    python scripts/build_docs.py --check    # build to a temp dir (CI)
+
+Supported markdown: ATX headings, fenced code blocks, inline code, links,
+bold/italic, unordered/ordered lists, tables, blockquotes, hrs.
+"""
+
+from __future__ import annotations
+
+import argparse
+import html
+import re
+import shutil
+import sys
+import tempfile
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+DOCS = REPO / "docs"
+
+# page order for the sidebar (index first, then the operator's journey)
+ORDER = ["index", "quick-start", "architecture", "models", "planner",
+         "rollback", "ingest", "scaling", "configuration", "operations",
+         "benchmarks"]
+
+_CSS = """
+:root { --fg:#1a1f24; --bg:#ffffff; --accent:#0b63c5; --muted:#5a6572;
+        --code-bg:#f4f6f8; --border:#dde3e9; }
+* { box-sizing: border-box; }
+body { margin:0; font:16px/1.65 system-ui,-apple-system,Segoe UI,sans-serif;
+       color:var(--fg); background:var(--bg); display:flex; }
+nav { width:230px; min-height:100vh; border-right:1px solid var(--border);
+      padding:1.2rem .9rem; position:sticky; top:0; align-self:flex-start; }
+nav h2 { font-size:.95rem; margin:.2rem 0 .8rem; }
+nav a { display:block; color:var(--muted); text-decoration:none;
+        padding:.22rem .5rem; border-radius:6px; font-size:.92rem; }
+nav a:hover { background:var(--code-bg); }
+nav a.active { color:var(--accent); font-weight:600; background:var(--code-bg); }
+main { max-width:860px; padding:2rem 2.6rem 4rem; }
+h1,h2,h3 { line-height:1.25; }
+h1 { font-size:1.8rem; border-bottom:1px solid var(--border); padding-bottom:.4rem; }
+a { color:var(--accent); }
+code { background:var(--code-bg); border-radius:4px; padding:.12em .35em;
+       font:.88em ui-monospace,Menlo,monospace; }
+pre { background:var(--code-bg); border:1px solid var(--border);
+      border-radius:8px; padding: .9rem 1.1rem; overflow-x:auto; }
+pre code { background:none; padding:0; }
+table { border-collapse:collapse; margin:1rem 0; font-size:.92rem; }
+th,td { border:1px solid var(--border); padding:.4rem .7rem; text-align:left; }
+th { background:var(--code-bg); }
+blockquote { border-left:3px solid var(--accent); margin:.8rem 0;
+             padding:.1rem 1rem; color:var(--muted); }
+hr { border:none; border-top:1px solid var(--border); margin:2rem 0; }
+"""
+
+
+def _inline(s: str) -> str:
+    s = html.escape(s, quote=False)
+    s = re.sub(r"`([^`]+)`", r"<code>\1</code>", s)
+    s = re.sub(r"\*\*([^*]+)\*\*", r"<strong>\1</strong>", s)
+    s = re.sub(r"(?<![\w*])\*([^*]+)\*(?![\w*])", r"<em>\1</em>", s)
+    s = re.sub(r"\[([^\]]+)\]\(([^)]+)\)",
+               lambda m: f'<a href="{_rewrite_href(m.group(2))}">{m.group(1)}</a>', s)
+    return s
+
+
+def _rewrite_href(href: str) -> str:
+    if href.endswith(".md") and "/" not in href:
+        return href[:-3] + ".html"
+    return href
+
+
+def md_to_html(text: str) -> str:
+    out: list[str] = []
+    lines = text.splitlines()
+    i = 0
+    in_list = None  # "ul" | "ol"
+
+    def close_list():
+        nonlocal in_list
+        if in_list:
+            out.append(f"</{in_list}>")
+            in_list = None
+
+    while i < len(lines):
+        line = lines[i]
+        if line.startswith("```"):
+            close_list()
+            i += 1
+            block = []
+            while i < len(lines) and not lines[i].startswith("```"):
+                block.append(lines[i])
+                i += 1
+            i += 1
+            out.append("<pre><code>" + html.escape("\n".join(block))
+                       + "</code></pre>")
+            continue
+        if re.match(r"^\|.*\|\s*$", line):
+            close_list()
+            rows = []
+            while i < len(lines) and re.match(r"^\|.*\|\s*$", lines[i]):
+                rows.append([c.strip() for c in lines[i].strip().strip("|").split("|")])
+                i += 1
+            out.append("<table>")
+            header = True
+            for r, cells in enumerate(rows):
+                if all(re.fullmatch(r":?-{2,}:?", c) for c in cells):
+                    continue  # separator row
+                tag = "th" if header else "td"
+                header = False
+                out.append("<tr>" + "".join(
+                    f"<{tag}>{_inline(c)}</{tag}>" for c in cells) + "</tr>")
+            out.append("</table>")
+            continue
+        m = re.match(r"^(#{1,4})\s+(.*)", line)
+        if m:
+            close_list()
+            lvl = len(m.group(1))
+            out.append(f"<h{lvl}>{_inline(m.group(2))}</h{lvl}>")
+            i += 1
+            continue
+        if re.match(r"^\s*([-*])\s+", line):
+            if in_list != "ul":
+                close_list()
+                out.append("<ul>")
+                in_list = "ul"
+            item = [re.sub(r"^\s*[-*]\s+", "", line)]
+            i += 1
+            # continuation lines (indented)
+            while i < len(lines) and re.match(r"^\s{2,}\S", lines[i]) \
+                    and not re.match(r"^\s*[-*]\s+", lines[i]):
+                item.append(lines[i].strip())
+                i += 1
+            out.append(f"<li>{_inline(' '.join(item))}</li>")
+            continue
+        if re.match(r"^\s*\d+\.\s+", line):
+            if in_list != "ol":
+                close_list()
+                out.append("<ol>")
+                in_list = "ol"
+            item = [re.sub(r"^\s*\d+\.\s+", "", line)]
+            i += 1
+            while i < len(lines) and re.match(r"^\s{2,}\S", lines[i]) \
+                    and not re.match(r"^\s*\d+\.\s+", lines[i]):
+                item.append(lines[i].strip())
+                i += 1
+            out.append(f"<li>{_inline(' '.join(item))}</li>")
+            continue
+        if line.startswith(">"):
+            close_list()
+            quote = []
+            while i < len(lines) and lines[i].startswith(">"):
+                quote.append(lines[i].lstrip("> "))
+                i += 1
+            out.append(f"<blockquote>{_inline(' '.join(quote))}</blockquote>")
+            continue
+        if re.match(r"^\s*(---+|\*\*\*+)\s*$", line):
+            close_list()
+            out.append("<hr>")
+            i += 1
+            continue
+        if not line.strip():
+            close_list()
+            i += 1
+            continue
+        # paragraph: greedily join consecutive text lines
+        close_list()
+        para = [line]
+        i += 1
+        while i < len(lines) and lines[i].strip() and not re.match(
+                r"^(#{1,4}\s|```|\||\s*[-*]\s+|\s*\d+\.\s+|>|\s*---)", lines[i]):
+            para.append(lines[i])
+            i += 1
+        out.append(f"<p>{_inline(' '.join(para))}</p>")
+    close_list()
+    return "\n".join(out)
+
+
+def _title_of(md: str, fallback: str) -> str:
+    for line in md.splitlines():
+        m = re.match(r"^#\s+(.*)", line)
+        if m:
+            return m.group(1)
+    return fallback
+
+
+def build(out_dir: Path) -> list[Path]:
+    out_dir.mkdir(parents=True, exist_ok=True)
+    pages = {p.stem: p.read_text() for p in DOCS.glob("*.md")}
+    order = [n for n in ORDER if n in pages] + sorted(
+        n for n in pages if n not in ORDER)
+    titles = {n: _title_of(pages[n], n.replace("-", " ").title())
+              for n in order}
+    written = []
+    for name in order:
+        nav = "\n".join(
+            f'<a href="{n}.html"{" class=\"active\"" if n == name else ""}>'
+            f"{html.escape(titles[n])}</a>" for n in order)
+        body = md_to_html(pages[name])
+        doc = f"""<!doctype html>
+<html lang="en"><head><meta charset="utf-8">
+<meta name="viewport" content="width=device-width, initial-scale=1">
+<title>{html.escape(titles[name])} — NERRF-TPU</title>
+<style>{_CSS}</style></head>
+<body><nav><h2>NERRF-TPU</h2>{nav}</nav>
+<main>{body}</main></body></html>
+"""
+        path = out_dir / f"{name}.html"
+        path.write_text(doc)
+        written.append(path)
+    return written
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--out", default=str(DOCS / "site"))
+    ap.add_argument("--check", action="store_true",
+                    help="build into a temp dir and report (CI mode)")
+    args = ap.parse_args(argv)
+    if args.check:
+        with tempfile.TemporaryDirectory() as tmp:
+            pages = build(Path(tmp))
+            print(f"docs site builds: {len(pages)} pages")
+        return 0
+    out = Path(args.out)
+    if out.exists():
+        shutil.rmtree(out)
+    pages = build(out)
+    print(f"wrote {len(pages)} pages to {out}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

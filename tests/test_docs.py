@@ -45,3 +45,25 @@ def test_referenced_modules_exist(repo_root):
     assert len(mods) >= 10, f"docs module-reference scan looks broken: {mods}"
     for mod in sorted(mods):
         importlib.import_module(mod)
+
+
+def test_docs_site_builds(tmp_path):
+    """The browsable-HTML surface (reference: fumadocs site) builds from the
+    markdown with zero deps; every guide becomes a page with nav."""
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    repo = Path(__file__).resolve().parent.parent
+    out = tmp_path / "site"
+    r = subprocess.run(
+        [sys.executable, str(repo / "scripts" / "build_docs.py"),
+         "--out", str(out)],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr
+    pages = sorted(p.name for p in out.glob("*.html"))
+    md = sorted(p.stem + ".html" for p in (repo / "docs").glob("*.md"))
+    assert pages == md
+    index = (out / "index.html").read_text()
+    for page in pages:
+        assert page in index  # nav links every page
