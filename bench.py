@@ -182,6 +182,37 @@ def main() -> None:
         except Exception as e:  # torch leg must never sink the bench
             log(f"[bench] torch baseline failed: {e!r}")
 
+    # --- round artifacts: results produced by longer offline runs ----------
+    # (the 100h corpus training and the adversarial eval take tens of
+    # minutes — they run via their own scripts and check their reports in;
+    # the bench surfaces the headline numbers with provenance)
+    artifacts = {}
+    try:
+        art_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "benchmarks", "results")
+        j100 = os.path.join(art_dir, "joint100h_r2.json")
+        if os.path.exists(j100):
+            r = json.load(open(j100))
+            artifacts["corpus100h"] = {
+                "hours": r.get("corpus_hours"),
+                "edge_auc": r.get("metrics", {}).get("edge_auc"),
+                "seq_f1": r.get("metrics", {}).get("seq_f1"),
+                "steps_per_sec": r.get("steps_per_sec"),
+                "provenance": "python -m nerrf_tpu.train.run "
+                              "--experiment joint-100h",
+            }
+        adv = os.path.join(art_dir, "adversarial_r2.json")
+        if os.path.exists(adv):
+            r = json.load(open(adv))
+            artifacts["adversarial"] = {
+                "fp_undo_rate_worst": r.get("kpi", {}).get(
+                    "fp_undo_rate_worst_model"),
+                "fp_undo_met": r.get("kpi", {}).get("fp_undo_met"),
+                "provenance": "python benchmarks/run_adversarial_eval.py",
+            }
+    except Exception as e:
+        log(f"[bench] artifact surfacing failed: {e!r}")
+
     print(json.dumps({
         "metric": "nerrfnet_train_steps_per_sec",
         "value": round(steps_per_sec, 3),
@@ -201,6 +232,7 @@ def main() -> None:
         "stream_events_per_sec":
             round(stream_events_per_sec) if stream_events_per_sec else None,
         "torch_cpu_steps_per_sec": round(torch_sps, 3) if torch_sps else None,
+        "artifacts": artifacts or None,
         "wall_seconds": round(time.perf_counter() - t_wall, 1),
     }))
 

@@ -1,0 +1,41 @@
+#!/bin/bash
+# Round-2 chip-work queue: waits for the TPU tunnel, then runs the offline
+# artifact producers serially (100h training, adversarial eval, graph
+# capacity crossover, planner throughput probe).  Safe to re-run; each step
+# is idempotent or overwrite-only.  Logs: /tmp/tpu_queue.log + per-step logs.
+cd "$(dirname "$0")/.."
+log() { echo "[queue $(date +%H:%M:%S)] $*" >> /tmp/tpu_queue.log; }
+log "watcher started"
+while true; do
+  if timeout 90 python -c "import jax; assert jax.default_backend()=='tpu'" 2>/dev/null; then
+    log "TPU is back"; break
+  fi
+  sleep 120
+done
+log "1/4 joint-100h training"
+timeout 3600 python -m nerrf_tpu.train.run --experiment joint-100h \
+  --out runs/joint-100h-r2 --ckpt-every 2000 > /tmp/joint100.log 2>&1
+log "joint-100h rc=$?"
+if [ -f runs/joint-100h-r2/metrics.json ]; then
+  mkdir -p benchmarks/results
+  cp runs/joint-100h-r2/metrics.json benchmarks/results/joint100h_r2.json
+  log "copied joint100h artifact"
+fi
+log "2/4 adversarial eval"
+if [ -f runs/joint-100h-r2/model/model_config.json ]; then
+  timeout 2400 python benchmarks/run_adversarial_eval.py \
+    --out benchmarks/results/adversarial_r2.json \
+    --model-dir runs/joint-100h-r2/model > /tmp/adv5.log 2>&1
+else
+  timeout 2400 python benchmarks/run_adversarial_eval.py \
+    --out benchmarks/results/adversarial_r2.json > /tmp/adv5.log 2>&1
+fi
+log "adversarial rc=$?"
+log "3/4 graph capacity (pallas crossover)"
+timeout 1200 python benchmarks/run_graph_capacity.py \
+  --out benchmarks/results/graph_capacity.json > /tmp/graphcap.log 2>&1
+log "graphcap rc=$?"
+log "4/4 planner throughput probe"
+timeout 1200 python benchmarks/run_planner_probe.py > /tmp/mcts_tpu.log 2>&1
+log "mcts rc=$?"
+log "queue done"
