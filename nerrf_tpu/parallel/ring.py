@@ -165,21 +165,35 @@ def _ring_shard(q, k, v, *, axis_name: str, manual_axes: tuple, causal: bool) ->
     def step(i, carry):
         o, m, l, k_blk, v_blk = carry
         src = (my - i) % p  # original owner of the block we hold now
-        scores = jnp.einsum(
-            "bqhd,bkhd->bhqk", q32, k_blk.astype(jnp.float32)
-        ) * scale
+
+        def attend(o, m, l):
+            scores = jnp.einsum(
+                "bqhd,bkhd->bhqk", q32, k_blk.astype(jnp.float32)
+            ) * scale
+            if causal:
+                k_pos = src * c + jax.lax.broadcasted_iota(
+                    jnp.int32, (1, c), 1)
+                scores = jnp.where((k_pos <= q_pos)[None, None], scores, _NEG)
+            m_new = jnp.maximum(m, scores.max(axis=-1))
+            pexp = jnp.exp(scores - m_new[..., None])
+            alpha = jnp.exp(m - m_new)
+            l_new = alpha * l + pexp.sum(axis=-1)
+            o_new = alpha.transpose(0, 2, 1)[..., None] * o + jnp.einsum(
+                "bhqk,bkhd->bqhd", pexp, v_blk.astype(jnp.float32)
+            )
+            return o_new, m_new, l_new
+
         if causal:
-            k_pos = src * c + jax.lax.broadcasted_iota(jnp.int32, (1, c), 1)
-            scores = jnp.where((k_pos <= q_pos)[None, None], scores, _NEG)
-        m_new = jnp.maximum(m, scores.max(axis=-1))
-        pexp = jnp.exp(scores - m_new[..., None])
-        alpha = jnp.exp(m - m_new)
-        l = alpha * l + pexp.sum(axis=-1)
-        o = alpha.transpose(0, 2, 1)[..., None] * o + jnp.einsum(
-            "bhqk,bkhd->bqhd", pexp, v_blk.astype(jnp.float32)
-        )
+            # a block from a strictly-future shard (src > my) is entirely
+            # masked — min k_pos = src·c exceeds max q_pos = my·c + c − 1 —
+            # so skip both matmuls; the ring rotation below still runs every
+            # hop (identical collective schedule on every shard)
+            o, m, l = jax.lax.cond(
+                src <= my, attend, lambda o, m, l: (o, m, l), o, m, l)
+        else:
+            o, m, l = attend(o, m, l)
         k_blk, v_blk = jax.lax.ppermute((k_blk, v_blk), axis_name, perm)
-        return o, m_new, l, k_blk, v_blk
+        return o, m, l, k_blk, v_blk
 
     # same residual blow-up as the local path: remat each ring step so the
     # backward pass recomputes scores instead of storing one [B,H,C,C] f32
