@@ -12,6 +12,9 @@ while true; do
   fi
   sleep 120
 done
+while [ ! -f datasets/corpus100/manifest.json ]; do
+  log "waiting for corpus100 generation"; sleep 60
+done
 log "1/4 joint-100h training"
 timeout 3600 python -m nerrf_tpu.train.run --experiment joint-100h \
   --out runs/joint-100h-r2 --ckpt-every 2000 > /tmp/joint100.log 2>&1
