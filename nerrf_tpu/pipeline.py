@@ -121,9 +121,37 @@ def model_detect(
     model: NerrfNet,
     ds_cfg: Optional[DatasetConfig] = None,
     batch_size: int = 8,
+    auto_capacity: bool = True,
 ) -> DetectionResult:
-    """Aggregate trained-model node scores across windows onto host ids."""
+    """Aggregate trained-model node scores across windows onto host ids.
+
+    ``auto_capacity`` sizes the graph capacities to the trace's densest
+    window (power-of-two bucket, `GraphConfig.fit` policy): at projected
+    live-capture density the training defaults silently drop ~34% of a
+    window's events (benchmarks/run_graph_capacity.py), and an online
+    detector must not be blind to a third of the evidence.  The model is
+    shape-polymorphic over capacities (one extra compile per bucket)."""
     ds_cfg = ds_cfg or DatasetConfig()
+    if auto_capacity and trace.events.num_valid:
+        from nerrf_tpu.graph.builder import measure_window, snapshot_windows
+
+        ev = trace.events
+        valid_ts = ev.ts_ns[ev.valid]
+        g = ds_cfg.graph
+        need_n = need_e = 0
+        for lo, hi in snapshot_windows(int(valid_ts.min()),
+                                       int(valid_ts.max()), g):
+            n, e = measure_window(ev, lo, hi)
+            need_n, need_e = max(need_n, n), max(need_e, e)
+        if need_n > g.max_nodes or need_e > g.max_edges:
+            def bucket(need, floor):
+                need = max(int(np.ceil(need * 1.25)), floor)
+                return 1 << int(np.ceil(np.log2(need)))
+
+            g = dataclasses.replace(
+                g, max_nodes=bucket(need_n, g.max_nodes),
+                max_edges=bucket(need_e, g.max_edges))
+            ds_cfg = dataclasses.replace(ds_cfg, graph=g)
     # detection must not peek at labels: strip them
     unlabelled = Trace(events=trace.events, strings=trace.strings,
                        ground_truth=None, labels=None, name=trace.name)

@@ -200,3 +200,44 @@ def test_graphconfig_fit_gives_zero_drops_at_high_density():
     assert fit.max_nodes >= base.max_nodes and (fit.max_nodes & (fit.max_nodes - 1)) == 0
     _, stats = build_window_graph(ev, tr.strings, lo, hi, fit)
     assert stats.dropped_nodes == 0 and stats.dropped_events == 0
+
+
+def test_model_detect_auto_capacity_covers_dense_traces():
+    """The online detector must see all evidence at live-capture density:
+    auto_capacity bumps the window capacities so nothing drops."""
+    import dataclasses as dc
+
+    from nerrf_tpu.data.synth import SimConfig, simulate_trace
+    from nerrf_tpu.graph.builder import GraphConfig, measure_window, snapshot_windows
+    from nerrf_tpu.models import JointConfig, NerrfNet
+    from nerrf_tpu.train.data import DatasetConfig
+    from nerrf_tpu.pipeline import model_detect
+    import jax
+
+    tr = simulate_trace(SimConfig(duration_sec=50.0, benign_rate_hz=300.0,
+                                  num_target_files=20, attack=True,
+                                  attack_start_sec=10.0, seed=13))
+    cfg = JointConfig(gnn=dc.replace(JointConfig().gnn, hidden=16, num_layers=2),
+                      lstm=dc.replace(JointConfig().lstm, hidden=16, num_layers=1))
+    model = NerrfNet(cfg)
+    ds = DatasetConfig(graph=GraphConfig(max_nodes=64, max_edges=128),
+                       seq_len=20, max_seqs=16)
+    ev = tr.events
+    ts = ev.ts_ns[ev.valid]
+    dense_needs = max(measure_window(ev, lo, hi)[0] for lo, hi in
+                      snapshot_windows(int(ts.min()), int(ts.max()), ds.graph))
+    assert dense_needs > 64  # the configured capacity would drop nodes
+
+    # init params at the small shape; detection at fitted shape must work
+    from nerrf_tpu.train.data import windows_of_trace
+    sample = windows_of_trace(tr, ds)[0]
+    import jax.numpy as jnp
+    from nerrf_tpu.train.loop import model_inputs
+    one = {k: jnp.asarray(v) for k, v in sample.items()}
+    params = model.init(jax.random.PRNGKey(0), *model_inputs(one))["params"]
+
+    det = model_detect(tr, params, model, ds_cfg=ds, batch_size=2,
+                       auto_capacity=True)
+    # every encrypted file is scoreable (present in the detection universe)
+    enc = [p for p in det.file_scores if p.endswith(".lockbit3")]
+    assert len(enc) >= 15, f"only {len(enc)} ransom files visible"
