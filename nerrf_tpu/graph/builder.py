@@ -103,21 +103,28 @@ class GraphConfig:
     max_nodes: int = 256
     max_edges: int = 512
 
-    def fit(self, events: "EventArrays", lo_ns: int, hi_ns: int,
-            headroom: float = 1.25) -> "GraphConfig":
-        """Capacities sized to THIS window's exact node/edge need (×headroom,
-        rounded up to a power of two, floored at the defaults)."""
-        n_nodes, n_edges = measure_window(events, lo_ns, hi_ns)
+    @staticmethod
+    def bucket(need: int, floor: int, headroom: float = 1.25) -> int:
+        """THE sizing policy: need × headroom, next power of two, floored.
+        Every auto-capacity consumer (fit, model_detect) goes through here
+        so the policy cannot silently diverge between paths."""
+        need = max(int(np.ceil(need * headroom)), floor)
+        return 1 << int(np.ceil(np.log2(need)))
 
-        def bucket(need: int, floor: int) -> int:
-            need = max(int(np.ceil(need * headroom)), floor)
-            return 1 << int(np.ceil(np.log2(need)))
-
+    def fit_counts(self, n_nodes: int, n_edges: int,
+                   headroom: float = 1.25) -> "GraphConfig":
+        """Capacities sized to given exact needs (bucket policy above)."""
         return dataclasses.replace(
             self,
-            max_nodes=bucket(n_nodes, self.max_nodes),
-            max_edges=bucket(n_edges, self.max_edges),
+            max_nodes=self.bucket(n_nodes, self.max_nodes, headroom),
+            max_edges=self.bucket(n_edges, self.max_edges, headroom),
         )
+
+    def fit(self, events: "EventArrays", lo_ns: int, hi_ns: int,
+            headroom: float = 1.25) -> "GraphConfig":
+        """Capacities sized to THIS window's exact node/edge need."""
+        n_nodes, n_edges = measure_window(events, lo_ns, hi_ns)
+        return self.fit_counts(n_nodes, n_edges, headroom)
 
 
 def measure_window(events: "EventArrays", lo_ns: int, hi_ns: int) -> Tuple[int, int]:

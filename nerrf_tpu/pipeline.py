@@ -138,20 +138,25 @@ def model_detect(
         ev = trace.events
         valid_ts = ev.ts_ns[ev.valid]
         g = ds_cfg.graph
-        need_n = need_e = 0
+        need_n = need_e = need_f = 0
         for lo, hi in snapshot_windows(int(valid_ts.min()),
                                        int(valid_ts.max()), g):
             n, e = measure_window(ev, lo, hi)
             need_n, need_e = max(need_n, n), max(need_e, e)
-        if need_n > g.max_nodes or need_e > g.max_edges:
-            def bucket(need, floor):
-                need = max(int(np.ceil(need * 1.25)), floor)
-                return 1 << int(np.ceil(np.log2(need)))
-
-            g = dataclasses.replace(
-                g, max_nodes=bucket(need_n, g.max_nodes),
-                max_edges=bucket(need_e, g.max_edges))
-            ds_cfg = dataclasses.replace(ds_cfg, graph=g)
+            sel = ev.valid & (ev.ts_ns >= lo) & (ev.ts_ns < hi)
+            files = len(np.unique(ev.inode[sel & (ev.inode > 0)]))
+            need_f = max(need_f, files)
+        if (need_n > g.max_nodes or need_e > g.max_edges
+                or need_f > ds_cfg.max_seqs):
+            # scale the sequence capacity with the file population too: the
+            # LSTM branch keeps only the max_seqs densest per-file sequences
+            # (train/data.py), and an online detector capped at 128 would
+            # still be sequence-blind to most files of a dense window
+            ds_cfg = dataclasses.replace(
+                ds_cfg,
+                graph=g.fit_counts(need_n, need_e),
+                max_seqs=g.bucket(need_f, ds_cfg.max_seqs),
+            )
     # detection must not peek at labels: strip them
     unlabelled = Trace(events=trace.events, strings=trace.strings,
                        ground_truth=None, labels=None, name=trace.name)
