@@ -1,0 +1,25 @@
+#!/bin/sh
+# Tracker pod entrypoint: live kernel capture when the node supports it,
+# replay service otherwise — one image serves both roles.
+#
+#   probe rc 0  → nerrf-trackerd (live eBPF capture → gRPC :50051)
+#   probe rc 2/3 → `nerrf serve` replay of the bundled toy trace, so the
+#                  downstream pipeline stays exercisable on clusters where
+#                  the node kernel or pod privileges rule out BPF.
+#
+# Note on capture feedback: in this topology subscribers (the ingest pod)
+# run on other nodes/pods, so their socket writes are not in this node's
+# capture scope; colocated subscribers should connect over the unix socket
+# (--listen unix:/...) where peer-pid exclusion works (SO_PEERCRED).
+set -eu
+ADDR="${TRACKER_LISTEN_ADDR:-0.0.0.0:50051}"
+
+if /app/native/build/nerrf-trackerd --probe; then
+    echo "[entrypoint] live capture available — starting nerrf-trackerd"
+    exec /app/native/build/nerrf-trackerd --listen "$ADDR"
+fi
+rc=$?
+echo "[entrypoint] live capture unavailable (probe rc=$rc) — replay mode"
+exec python -m nerrf_tpu.cli serve \
+    --trace /app/datasets/traces/toy_trace.csv \
+    --address "$ADDR" --metrics-port 9090 --duration 0
