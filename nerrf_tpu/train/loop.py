@@ -332,6 +332,7 @@ def train_sharded_stream(
     passes_per_shard: int = 2,
     ckpt_dir=None,
     save_every: int = 0,
+    upload_chunk_bytes: int = 64 << 20,
 ) -> TrainResult:
     """100 h-scale training: rotate disk shards through HBM, double-buffered.
 
@@ -342,8 +343,9 @@ def train_sharded_stream(
     while the chip trains on shard i; the consumer issues the (async)
     device_put for i+1 as soon as it starts computing on i, so the upload
     hides behind `passes_per_shard` epochs of scheduled batches and HBM
-    never holds more than two shards.  Shard order reshuffles every corpus
-    epoch (block-shuffled SGD).
+    holds at most two shards plus one transient copy of the largest array
+    (chunked-upload reassembly; ``upload_chunk_bytes``).  Shard order
+    reshuffles every corpus epoch (block-shuffled SGD).
 
     ``ckpt_dir``/``save_every`` enable periodic full-state checkpoints and
     resume-from-latest (elastic.py machinery).  Resume restores params/
@@ -354,6 +356,41 @@ def train_sharded_stream(
     """
     import queue as queue_mod
     import threading
+
+    def put_chunked(arrays, max_bytes=None, block=False):
+        """device_put a shard dict in bounded-size pieces.
+
+        A single >0.5 GB transfer has wedged the host↔TPU relay in this
+        environment; slicing the upload along the window axis keeps each
+        PJRT transfer small and makes progress observable.  Pieces are
+        reassembled on device, so peak HBM is two shards plus one
+        transient copy of the largest array (freed once the concatenate
+        runs).  ``block=True`` waits and logs throughput (used for the
+        first shard, which gates init anyway); prefetch uploads stay
+        async so they overlap the current shard's steps.
+        """
+        max_bytes = upload_chunk_bytes if max_bytes is None else max_bytes
+        out = {}
+        t0 = time.perf_counter()
+        total = 0
+        for k, v in arrays.items():
+            v = np.asarray(v)
+            nbytes = v.nbytes
+            total += nbytes
+            if nbytes <= max_bytes or v.shape[0] < 2:
+                out[k] = jax.device_put(v)
+            else:
+                rows = max(1, int(v.shape[0] * max_bytes // nbytes))
+                pieces = [jax.device_put(v[i:i + rows])
+                          for i in range(0, v.shape[0], rows)]
+                out[k] = jnp.concatenate(pieces, axis=0)
+        if block:
+            jax.block_until_ready(out)
+            if log:
+                dt = time.perf_counter() - t0
+                log(f"shard upload: {total / 1e9:.2f} GB in {dt:.1f}s "
+                    f"({total / 1e9 / max(dt, 1e-9):.2f} GB/s)")
+        return out
 
     cfg = cfg or TrainConfig()
     model = NerrfNet(cfg.model)
@@ -409,7 +446,7 @@ def train_sharded_stream(
 
     rng = jax.random.PRNGKey(cfg.seed)
     rng, init_rng = jax.random.split(rng)
-    shard = jax.device_put(next_host_shard())
+    shard = put_chunked(next_host_shard(), block=True)
     state = init_state(model, cfg, shard, init_rng)
 
     steps_done = 0
@@ -431,7 +468,7 @@ def train_sharded_stream(
     try:
         while steps_done < cfg.num_steps:
             # stage the next shard: async upload overlaps this shard's steps
-            nxt = jax.device_put(next_host_shard()) \
+            nxt = put_chunked(next_host_shard()) \
                 if steps_done + _shard_steps(shard, cfg, passes_per_shard) \
                 < cfg.num_steps else None
             n = int(shard["node_feat"].shape[0])
