@@ -128,6 +128,47 @@ def make_train_step_resident(model: NerrfNet, cfg: TrainConfig, arrays):
     return step
 
 
+def device_put_chunked(arrays, max_bytes: int = 64 << 20, block: bool = False,
+                       log=None):
+    """device_put a dict of host arrays in bounded-size pieces.
+
+    A single >0.5 GB transfer has wedged the host↔TPU relay in this
+    environment, so every dataset-sized upload goes through this helper:
+    arrays larger than ``max_bytes`` are sliced along axis 0 and
+    reassembled on device.  Since transfers and the concatenates that free
+    the pieces dispatch async, the worst-case transient is one extra copy
+    of the input until the queued concatenates execute.  ``block=True``
+    waits and (with ``log``) reports throughput; leave it False where the
+    upload should overlap other work.
+    """
+    out = {}
+    t0 = time.perf_counter()
+    total = 0
+    for k, v in arrays.items():
+        v = np.asarray(v)
+        nbytes = v.nbytes
+        total += nbytes
+        if nbytes <= max_bytes or v.shape[0] < 2:
+            out[k] = jax.device_put(v)
+        else:
+            rows = max(1, int(v.shape[0] * max_bytes // nbytes))
+            if log and rows == 1 and nbytes > max_bytes * v.shape[0]:
+                log(f"upload warning: single rows of '{k}' exceed the "
+                    f"{max_bytes >> 20} MB chunk bound "
+                    f"({nbytes // v.shape[0] >> 20} MB/row) — transfers "
+                    "stay monolithic per row")
+            pieces = [jax.device_put(v[i:i + rows])
+                      for i in range(0, v.shape[0], rows)]
+            out[k] = jnp.concatenate(pieces, axis=0)
+    if block:
+        jax.block_until_ready(out)
+        if log:
+            dt = time.perf_counter() - t0
+            log(f"upload: {total / 1e9:.2f} GB in {dt:.1f}s "
+                f"({total / 1e9 / max(dt, 1e-9):.2f} GB/s)")
+    return out
+
+
 def make_train_step_scheduled(model: NerrfNet, cfg: TrainConfig, arrays,
                               idx_table: np.ndarray):
     """Fully device-driven training: the HBM-resident dataset *and* the whole
@@ -144,7 +185,8 @@ def _make_resident_steps(model: NerrfNet, cfg: TrainConfig, arrays):
     """One factory for both resident flavors, sharing placement, the gather,
     and the step body (so fixes to any of them apply to both)."""
     loss_fn = make_loss_fn(model, cfg)
-    dev = {k: jax.device_put(v) for k, v in arrays.items()}
+    # async: the chunked upload overlaps the caller's jit tracing/compile
+    dev = device_put_chunked(arrays)
 
     def gathered_step(state, idx, rng, data):
         batch = {k: jnp.take(v, idx, axis=0) for k, v in data.items()}
@@ -343,9 +385,10 @@ def train_sharded_stream(
     while the chip trains on shard i; the consumer issues the (async)
     device_put for i+1 as soon as it starts computing on i, so the upload
     hides behind `passes_per_shard` epochs of scheduled batches and HBM
-    holds at most two shards plus one transient copy of the largest array
-    (chunked-upload reassembly; ``upload_chunk_bytes``).  Shard order
-    reshuffles every corpus epoch (block-shuffled SGD).
+    holds two resident shards plus, transiently, up to one extra copy of
+    the incoming shard while chunked-upload reassembly drains
+    (``upload_chunk_bytes``).  Shard order reshuffles every corpus epoch
+    (block-shuffled SGD).
 
     ``ckpt_dir``/``save_every`` enable periodic full-state checkpoints and
     resume-from-latest (elastic.py machinery).  Resume restores params/
@@ -357,40 +400,13 @@ def train_sharded_stream(
     import queue as queue_mod
     import threading
 
-    def put_chunked(arrays, max_bytes=None, block=False):
-        """device_put a shard dict in bounded-size pieces.
-
-        A single >0.5 GB transfer has wedged the host↔TPU relay in this
-        environment; slicing the upload along the window axis keeps each
-        PJRT transfer small and makes progress observable.  Pieces are
-        reassembled on device, so peak HBM is two shards plus one
-        transient copy of the largest array (freed once the concatenate
-        runs).  ``block=True`` waits and logs throughput (used for the
-        first shard, which gates init anyway); prefetch uploads stay
-        async so they overlap the current shard's steps.
-        """
-        max_bytes = upload_chunk_bytes if max_bytes is None else max_bytes
-        out = {}
-        t0 = time.perf_counter()
-        total = 0
-        for k, v in arrays.items():
-            v = np.asarray(v)
-            nbytes = v.nbytes
-            total += nbytes
-            if nbytes <= max_bytes or v.shape[0] < 2:
-                out[k] = jax.device_put(v)
-            else:
-                rows = max(1, int(v.shape[0] * max_bytes // nbytes))
-                pieces = [jax.device_put(v[i:i + rows])
-                          for i in range(0, v.shape[0], rows)]
-                out[k] = jnp.concatenate(pieces, axis=0)
-        if block:
-            jax.block_until_ready(out)
-            if log:
-                dt = time.perf_counter() - t0
-                log(f"shard upload: {total / 1e9:.2f} GB in {dt:.1f}s "
-                    f"({total / 1e9 / max(dt, 1e-9):.2f} GB/s)")
-        return out
+    def put_chunked(arrays, block=False):
+        # device_put_chunked, bound to this run's chunk size and logger;
+        # the first shard blocks (it gates init anyway) and logs
+        # throughput, prefetch uploads stay async so they overlap the
+        # current shard's steps.
+        return device_put_chunked(arrays, max_bytes=upload_chunk_bytes,
+                                  block=block, log=log)
 
     cfg = cfg or TrainConfig()
     model = NerrfNet(cfg.model)
