@@ -27,6 +27,23 @@ import time
 
 def main() -> None:
     t_wall = time.perf_counter()
+    # The axon tunnel has wedged mid-round twice; when it does, the first
+    # in-process device call blocks forever.  The bench must print its one
+    # JSON line either way, so establish reachability in a killable child
+    # first (nerrf_tpu.utils.probe_backend — stdlib-only import).
+    from nerrf_tpu.utils import probe_backend
+
+    ok, detail, _ = probe_backend(timeout_sec=180.0)
+    if not ok:
+        print(json.dumps({
+            "metric": "nerrfnet_train_steps_per_sec",
+            "value": None,
+            "unit": "steps/s",
+            "vs_baseline": None,
+            "error": f"backend unreachable: {detail} — no metrics "
+                     "measurable on this host right now",
+        }))
+        sys.exit(1)
     import jax
     import jax.numpy as jnp
     import numpy as np

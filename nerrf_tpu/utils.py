@@ -1,0 +1,62 @@
+"""Small host-side utilities shared by the bench, doctor, and entry points.
+
+Only stdlib at module level: these helpers exist to run *before* any JAX
+backend initialization (probing whether that init would hang), so they must
+be importable without touching jax.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+
+
+def probe_backend(timeout_sec: float = 120.0) -> tuple[bool, str, int]:
+    """Initialize the JAX backend in a bounded, killable subprocess.
+
+    A dead accelerator tunnel (seen twice with the axon relay) makes the
+    first in-process ``jax.devices()`` block forever, so anything that must
+    terminate — the bench's one JSON line, the env doctor, the multichip
+    dry run — establishes reachability here first.
+
+    Hard-won details: output goes to a temp file, not a pipe (a runtime
+    helper process inheriting the pipe's write end would keep a
+    ``communicate()`` blocked past the timeout), and the child gets its own
+    session so the whole process group can be killed on timeout.
+
+    Returns ``(ok, detail, count)``: detail is a human-readable backend
+    summary on success ("tpu x1 (TPU v5 lite)"), or the failure cause;
+    count is the device count (0 on failure).
+    """
+    code = ("import jax; d = jax.devices(); "
+            "print('PROBE_OK %d %s x%d (%s)' % "
+            "(len(d), jax.default_backend(), len(d), d[0].device_kind))")
+    try:
+        with tempfile.TemporaryFile(mode="w+") as out, \
+                tempfile.TemporaryFile(mode="w+") as err:
+            p = subprocess.Popen(
+                [sys.executable, "-c", code],
+                stdout=out, stderr=err, start_new_session=True,
+            )
+            try:
+                rc = p.wait(timeout=timeout_sec)
+            except subprocess.TimeoutExpired:
+                os.killpg(p.pid, signal.SIGKILL)
+                p.wait()
+                return False, (
+                    f"backend init did not respond in {timeout_sec:.0f}s "
+                    "(accelerator tunnel down?)"), 0
+            out.seek(0)
+            err.seek(0)
+            # runtime/plugin logs may surround the marker line
+            for line in reversed(out.read().splitlines()):
+                if line.startswith("PROBE_OK "):
+                    n, _, detail = line[len("PROBE_OK "):].partition(" ")
+                    return True, detail, int(n)
+            tail = err.read().strip().splitlines()
+            return False, (tail[-1][:200] if tail else f"probe rc={rc}"), 0
+    except Exception as e:  # spawn/IO failure on *this* host, not the tunnel
+        return False, f"probe could not run: {type(e).__name__}: {e}", 0

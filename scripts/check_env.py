@@ -26,6 +26,8 @@ import subprocess
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:  # the doctor runs from anywhere
+    sys.path.insert(0, REPO)
 
 REQUIRED_MODULES = ["jax", "flax", "optax", "orbax.checkpoint", "numpy",
                     "grpc", "google.protobuf"]
@@ -50,22 +52,17 @@ def _module(mod):
 
 
 def _jax_backend():
-    # Probe in a bounded subprocess: a dead accelerator tunnel makes
-    # jax.devices() block forever in-process, and a doctor that hangs is
-    # worse than a failing check.
-    code = ("import jax; d = jax.devices(); "
-            "print(f'{jax.default_backend()} x{len(d)} ({d[0].device_kind})')")
-    try:
-        r = subprocess.run([sys.executable, "-c", code], capture_output=True,
-                           text=True, timeout=75)
-    except subprocess.TimeoutExpired:
-        raise TimeoutError(
-            "backend init did not respond in 75s (accelerator tunnel down?) "
-            "— CPU fallback: jax.config.update('jax_platforms', 'cpu')")
-    if r.returncode != 0:
-        raise RuntimeError(r.stderr.strip().splitlines()[-1][:200]
-                           if r.stderr.strip() else f"rc={r.returncode}")
-    return r.stdout.strip()
+    # Probe in a bounded subprocess (shared helper — a dead accelerator
+    # tunnel makes jax.devices() block forever in-process, and a doctor
+    # that hangs is worse than a failing check).
+    from nerrf_tpu.utils import probe_backend
+
+    ok, detail, _ = probe_backend(timeout_sec=75)
+    if not ok:
+        raise RuntimeError(
+            f"{detail} — CPU fallback: "
+            "jax.config.update('jax_platforms', 'cpu')")
+    return detail
 
 
 def _toolchain(tool):

@@ -1,8 +1,9 @@
 #!/bin/bash
 # Round-2 chip-work queue: waits for the TPU tunnel, then runs the offline
 # artifact producers serially (100h training, adversarial eval, graph
-# capacity crossover, planner throughput probe).  Safe to re-run; each step
-# is idempotent or overwrite-only.  Logs: /tmp/tpu_queue.log + per-step logs.
+# capacity crossover, planner throughput probe, bench.py smoke →
+# /tmp/bench_smoke.json).  Safe to re-run; each step is idempotent or
+# overwrite-only.  Logs: /tmp/tpu_queue.log + per-step logs.
 cd "$(dirname "$0")/.."
 log() { echo "[queue $(date +%H:%M:%S)] $*" >> /tmp/tpu_queue.log; }
 log "watcher started"
@@ -15,7 +16,7 @@ done
 while [ ! -f datasets/corpus100/manifest.json ]; do
   log "waiting for corpus100 generation"; sleep 60
 done
-log "1/4 joint-100h training"
+log "1/5 joint-100h training"
 timeout 3600 python -m nerrf_tpu.train.run --experiment joint-100h \
   --out runs/joint-100h-r2 --ckpt-every 2000 > /tmp/joint100.log 2>&1
 log "joint-100h rc=$?"
@@ -24,7 +25,7 @@ if [ -f runs/joint-100h-r2/metrics.json ]; then
   cp runs/joint-100h-r2/metrics.json benchmarks/results/joint100h_r2.json
   log "copied joint100h artifact"
 fi
-log "2/4 adversarial eval"
+log "2/5 adversarial eval"
 if [ -f runs/joint-100h-r2/model/model_config.json ]; then
   timeout 2400 python benchmarks/run_adversarial_eval.py \
     --out benchmarks/results/adversarial_r2.json \
@@ -34,11 +35,14 @@ else
     --out benchmarks/results/adversarial_r2.json > /tmp/adv5.log 2>&1
 fi
 log "adversarial rc=$?"
-log "3/4 graph capacity (pallas crossover)"
+log "3/5 graph capacity (pallas crossover)"
 timeout 1200 python benchmarks/run_graph_capacity.py \
   --out benchmarks/results/graph_capacity.json > /tmp/graphcap.log 2>&1
 log "graphcap rc=$?"
-log "4/4 planner throughput probe"
+log "4/5 planner throughput probe"
 timeout 1200 python benchmarks/run_planner_probe.py > /tmp/mcts_tpu.log 2>&1
 log "mcts rc=$?"
+log "5/5 bench.py smoke (validates the driver's benchmark of record)"
+timeout 2400 python bench.py > /tmp/bench_smoke.json 2> /tmp/bench_smoke.log
+log "bench rc=$?"
 log "queue done"
