@@ -134,9 +134,16 @@ def main(argv=None) -> int:
     ap.add_argument("--train-steps", type=int, default=300)
     ap.add_argument("--traces", type=int, default=6)
     ap.add_argument("--seed", type=int, default=77)
+    ap.add_argument("--platform", default=None,
+                    help="force a JAX platform (e.g. 'cpu') before backend "
+                         "init — env vars can't override the axon "
+                         "sitecustomize on this host, jax.config can")
     args = ap.parse_args(argv)
 
     import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
 
     from nerrf_tpu.data.synth import make_corpus
     from nerrf_tpu.models import NerrfNet

@@ -122,14 +122,23 @@ def bench_segment_crossover(report: dict) -> None:
             return (time.perf_counter() - t0) / reps * 1e6
 
         xla_us = timed(jax.jit(
-            lambda i, d, n=n: jax.ops.segment_sum(d, i, num_segments=n)))
+            lambda i, d, n=n: jax.ops.segment_sum(
+                d, i, num_segments=n, indices_are_sorted=True)))
         pal_us = timed(jax.jit(
             lambda i, d, n=n: pallas_segment.segment_sum(
                 d, i, num_segments=n)))
+        srt_us = timed(jax.jit(
+            lambda i, d, n=n: pallas_segment.segment_sum_sorted(
+                d, i, num_segments=n)))
+        best = min(xla_us, pal_us, srt_us)
         rows.append({"nodes": n, "edges": e, "xla_us": round(xla_us, 1),
-                     "pallas_us": round(pal_us, 1),
-                     "pallas_wins": bool(pal_us < xla_us)})
-        _log(f"  segsum n={n} e={e}: xla {xla_us:.0f}us pallas {pal_us:.0f}us")
+                     "pallas_dense_us": round(pal_us, 1),
+                     "pallas_sorted_us": round(srt_us, 1),
+                     "winner": ("xla" if best == xla_us else
+                                "pallas_dense" if best == pal_us else
+                                "pallas_sorted")})
+        _log(f"  segsum n={n} e={e}: xla {xla_us:.0f}us "
+             f"dense {pal_us:.0f}us sorted {srt_us:.0f}us")
     report["pallas_crossover"] = rows
 
 

@@ -22,18 +22,25 @@ import jax.numpy as jnp
 
 # Optional overrides installed by nerrf_tpu.ops.pallas_segment.register().
 _SEGMENT_SUM_IMPL: Optional[Callable] = None
+_SEGMENT_SUM_SORTED_IMPL: Optional[Callable] = None
 _GATHER_IMPL: Optional[Callable] = None
 _AUTO_TRIED = False
 
 
-def use_pallas(sum_fn: Optional[Callable], gather_fn: Optional[Callable] = None) -> None:
+def use_pallas(sum_fn: Optional[Callable], gather_fn: Optional[Callable] = None,
+               sorted_sum_fn: Optional[Callable] = None) -> None:
     """Install (or clear) pallas segment-sum / row-gather implementations.
+
+    ``sorted_sum_fn`` (if given) serves calls that declare nondecreasing ids
+    (the builder's sorted-by-dst layout) — the banded kernel with linear MXU
+    work; ``sum_fn`` stays the order-independent fallback.
 
     An explicit call — including clearing — is a deliberate choice, so it also
     disables the one-shot TPU auto-probe in :func:`_maybe_auto_register`.
     """
-    global _SEGMENT_SUM_IMPL, _GATHER_IMPL, _AUTO_TRIED
+    global _SEGMENT_SUM_IMPL, _SEGMENT_SUM_SORTED_IMPL, _GATHER_IMPL, _AUTO_TRIED
     _SEGMENT_SUM_IMPL = sum_fn
+    _SEGMENT_SUM_SORTED_IMPL = sorted_sum_fn
     _GATHER_IMPL = gather_fn
     _AUTO_TRIED = True
 
@@ -59,19 +66,25 @@ def segment_sum(
     segment_ids: jnp.ndarray,
     num_segments: int,
     *,
-    sorted_ids: bool = True,
+    sorted_ids: bool = False,
 ) -> jnp.ndarray:
-    """Sum rows of ``data`` [E, F] into ``num_segments`` buckets [N, F]."""
+    """Sum rows of ``data`` [E, F] into ``num_segments`` buckets [N, F].
+
+    ``sorted_ids=True`` is a *contract*, not a hint: it routes to the banded
+    Pallas kernel, which silently drops out-of-band rows if ids are not
+    actually nondecreasing.  The default is therefore the safe
+    order-independent path; declare sortedness only where the layout
+    guarantees it (the builder's sorted-by-dst edges)."""
     _maybe_auto_register()
-    # The Pallas one-hot contraction is order-independent — no sortedness
-    # requirement (see pallas_segment.py) — but it computes through f32, so
-    # integer data keeps the exact XLA path.
-    if (
-        _SEGMENT_SUM_IMPL is not None
-        and data.ndim == 2
-        and jnp.issubdtype(data.dtype, jnp.floating)
-    ):
-        return _SEGMENT_SUM_IMPL(data, segment_ids, num_segments)
+    # The Pallas kernels compute through f32, so integer data keeps the
+    # exact XLA path.  Callers declaring sorted ids (the builder's
+    # sorted-by-dst edges) get the banded kernel — linear MXU work; the
+    # dense one-hot contraction is order-independent and serves the rest.
+    if data.ndim == 2 and jnp.issubdtype(data.dtype, jnp.floating):
+        if sorted_ids and _SEGMENT_SUM_SORTED_IMPL is not None:
+            return _SEGMENT_SUM_SORTED_IMPL(data, segment_ids, num_segments)
+        if _SEGMENT_SUM_IMPL is not None:
+            return _SEGMENT_SUM_IMPL(data, segment_ids, num_segments)
     return jax.ops.segment_sum(
         data, segment_ids, num_segments=num_segments, indices_are_sorted=sorted_ids
     )
@@ -83,9 +96,11 @@ def segment_mean(
     num_segments: int,
     weights: Optional[jnp.ndarray] = None,
     *,
-    sorted_ids: bool = True,
+    sorted_ids: bool = False,
 ) -> jnp.ndarray:
-    """(Weighted) mean aggregation; safe for empty segments."""
+    """(Weighted) mean aggregation; safe for empty segments.
+
+    ``sorted_ids`` follows :func:`segment_sum`'s contract semantics."""
     if weights is not None:
         w = weights[:, None] if weights.ndim == 1 else weights
         total = segment_sum(data * w, segment_ids, num_segments, sorted_ids=sorted_ids)

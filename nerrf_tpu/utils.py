@@ -14,7 +14,8 @@ import sys
 import tempfile
 
 
-def probe_backend(timeout_sec: float = 120.0) -> tuple[bool, str, int]:
+def probe_backend(timeout_sec: float = 120.0,
+                  _code: str | None = None) -> tuple[bool, str, int]:
     """Initialize the JAX backend in a bounded, killable subprocess.
 
     A dead accelerator tunnel (seen twice with the axon relay) makes the
@@ -29,11 +30,14 @@ def probe_backend(timeout_sec: float = 120.0) -> tuple[bool, str, int]:
 
     Returns ``(ok, detail, count)``: detail is a human-readable backend
     summary on success ("tpu x1 (TPU v5 lite)"), or the failure cause;
-    count is the device count (0 on failure).
+    count is the device count (0 on failure).  ``_code`` substitutes the
+    child's program (test hook: exercising the timeout/parse paths must
+    not depend on a real backend).
     """
-    code = ("import jax; d = jax.devices(); "
-            "print('PROBE_OK %d %s x%d (%s)' % "
-            "(len(d), jax.default_backend(), len(d), d[0].device_kind))")
+    code = _code if _code is not None else (
+        "import jax; d = jax.devices(); "
+        "print('PROBE_OK %d %s x%d (%s)' % "
+        "(len(d), jax.default_backend(), len(d), d[0].device_kind))")
     try:
         with tempfile.TemporaryFile(mode="w+") as out, \
                 tempfile.TemporaryFile(mode="w+") as err:

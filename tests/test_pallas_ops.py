@@ -47,6 +47,102 @@ def test_segment_sum_empty_segments_are_zero():
     np.testing.assert_allclose(out[4:], 0.0)
 
 
+@pytest.mark.parametrize("E,N,F", [(37, 11, 5), (300, 300, 64), (512, 40, 130)])
+def test_sorted_segment_sum_matches_xla(E, N, F):
+    ids = jnp.asarray(
+        np.sort(np.random.default_rng(21).integers(0, N, size=E)), jnp.int32)
+    data = _rand((E, F), 22)
+    got = pallas_segment.segment_sum_sorted(data, ids, N, True)
+    want = jax.ops.segment_sum(data, ids, num_segments=N,
+                               indices_are_sorted=True)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_sorted_segment_sum_skewed_band():
+    # worst-case skew: every edge lands in one segment (band spans all edge
+    # tiles for that segment tile, zero band everywhere else)
+    E, N, F = 400, 257, 9
+    ids = jnp.full((E,), 131, jnp.int32)
+    data = _rand((E, F), 23)
+    got = pallas_segment.segment_sum_sorted(data, ids, N, True)
+    want = jax.ops.segment_sum(data, ids, num_segments=N)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_sorted_segment_sum_builder_padding_layout():
+    # the builder's layout: sorted valid prefix, then padding slots pointing
+    # at the last node (builder.py:474-478) — still globally nondecreasing
+    N, F = 64, 12
+    valid = np.sort(np.random.default_rng(24).integers(0, 50, size=90))
+    ids = jnp.asarray(np.concatenate([valid, np.full(38, N - 1)]), jnp.int32)
+    data = _rand((128, F), 25)
+    got = pallas_segment.segment_sum_sorted(data, ids, N, True)
+    want = jax.ops.segment_sum(data, ids, num_segments=N)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_sorted_segment_sum_band_past_end_no_edge_padding():
+    # E an exact multiple of the edge tile (no pad ids), every id far below
+    # the upper segment tiles: their bands sit entirely past the last edge
+    # tile and the block index must clamp into range (review finding)
+    E, N, F = 128, 257, 7
+    ids = jnp.asarray(np.sort(np.random.default_rng(29).integers(0, 60, E)),
+                      jnp.int32)
+    data = _rand((E, F), 30)
+    got = pallas_segment.segment_sum_sorted(data, ids, N, True)
+    want = jax.ops.segment_sum(data, ids, num_segments=N)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_sorted_segment_sum_grad_is_gather():
+    ids = jnp.asarray([0, 1, 1, 2], jnp.int32)
+    data = _rand((4, 3), 26)
+
+    def loss(d):
+        return jnp.sum(pallas_segment.segment_sum_sorted(d, ids, 3, True) ** 2)
+
+    g = jax.grad(loss)(data)
+    want = jax.grad(
+        lambda d: jnp.sum(jax.ops.segment_sum(d, ids, num_segments=3) ** 2)
+    )(data)
+    np.testing.assert_allclose(g, want, rtol=1e-5, atol=1e-5)
+
+
+def test_switchboard_routes_sorted_calls_to_banded_kernel(monkeypatch):
+    pallas_segment.register(interpret=True)
+    calls = []
+    real = segment._SEGMENT_SUM_SORTED_IMPL
+    monkeypatch.setattr(segment, "_SEGMENT_SUM_SORTED_IMPL",
+                        lambda *a: calls.append(1) or real(*a))
+    data = _rand((20, 7), 27)
+    ids = jnp.asarray(np.sort(np.random.default_rng(28).integers(0, 9, 20)),
+                      jnp.int32)
+    got = segment.segment_sum(data, ids, 9, sorted_ids=True)
+    assert calls, "sorted_ids=True must route to the banded kernel"
+    np.testing.assert_allclose(
+        got, jax.ops.segment_sum(data, ids, num_segments=9),
+        rtol=1e-5, atol=1e-5)
+    calls.clear()
+    segment.segment_sum(data, ids, 9, sorted_ids=False)
+    assert not calls, "unsorted calls must not use the banded kernel"
+
+
+def test_sorted_segment_sum_under_vmap_and_grad():
+    # the model vmaps aggregation over the window batch — the banded
+    # kernel (scalar-prefetch grid) must batch and differentiate there
+    B, E, N, F = 3, 150, 40, 9
+    rng = np.random.default_rng(31)
+    ids = jnp.asarray(np.sort(rng.integers(0, N, (B, E)), axis=1), jnp.int32)
+    data = jnp.asarray(rng.normal(size=(B, E, F)), jnp.float32)
+    f = jax.vmap(lambda d, i: pallas_segment.segment_sum_sorted(d, i, N, True))
+    want_f = jax.vmap(lambda d, i: jax.ops.segment_sum(d, i, num_segments=N))
+    np.testing.assert_allclose(f(data, ids), want_f(data, ids),
+                               rtol=1e-5, atol=1e-5)
+    g = jax.grad(lambda d: jnp.sum(f(d, ids) ** 2))(data)
+    want_g = jax.grad(lambda d: jnp.sum(want_f(d, ids) ** 2))(data)
+    np.testing.assert_allclose(g, want_g, rtol=1e-4, atol=1e-4)
+
+
 def test_gather_rows_matches_take():
     table = _rand((45, 19), 2)
     idx = jnp.asarray(np.random.default_rng(3).integers(0, 45, size=130), jnp.int32)

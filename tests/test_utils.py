@@ -1,0 +1,41 @@
+"""nerrf_tpu.utils.probe_backend: the bounded backend probe every
+terminating entry point (bench.py, env doctor, dryrun_multichip) relies on.
+The `_code` hook substitutes the child program so these tests exercise the
+probe machinery itself, not a backend."""
+
+from nerrf_tpu.utils import probe_backend
+
+
+def test_probe_parses_marker_amid_noise():
+    ok, detail, count = probe_backend(
+        timeout_sec=30,
+        _code="print('runtime log line'); print('PROBE_OK 8 cpu x8 (cpu)'); "
+              "print('trailing log')")
+    assert ok and count == 8
+    assert detail == "cpu x8 (cpu)"
+
+
+def test_probe_timeout_kills_process_group():
+    # the child spawns a grandchild inheriting stdout; with pipes this
+    # would block past the timeout (the wedge this helper exists for)
+    ok, detail, count = probe_backend(
+        timeout_sec=2,
+        _code="import subprocess, sys, time; "
+              "subprocess.Popen([sys.executable, '-c', 'import time; "
+              "time.sleep(60)']); time.sleep(60)")
+    assert not ok and count == 0
+    assert "did not respond" in detail
+
+
+def test_probe_child_failure_reports_stderr_tail():
+    ok, detail, count = probe_backend(
+        timeout_sec=30,
+        _code="import sys; print('boom: no backend', file=sys.stderr); "
+              "sys.exit(3)")
+    assert not ok and count == 0
+    assert "boom: no backend" in detail
+
+
+def test_probe_child_success_without_marker_is_failure():
+    ok, detail, count = probe_backend(timeout_sec=30, _code="print('hi')")
+    assert not ok and count == 0
