@@ -189,12 +189,117 @@ def _segment_sum_sorted_call(
         _segment_sum_sorted_kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((n_pad, Fp), jnp.float32),
+        # typical-case banded cost (band ≈ 2 edge tiles per segment tile);
+        # the dense kernels' estimates are the quadratic upper bound
+        cost_estimate=pl.CostEstimate(
+            flops=2 * 2 * _TE * n_pad * Fp,
+            bytes_accessed=4 * (2 * n_pad * _TE // _TN * Fp + n_pad * Fp)
+            + 4 * Ep,
+            transcendentals=0,
+        ),
         interpret=interpret,
     )(t0, t1, ids, dat)
     return out[:num_segments, :F].astype(data.dtype)
 
 
+# --- sorted row gather (the banded sum's adjoint) ----------------------------
+#
+# grad_data[e] = g[ids[e]] with *nondecreasing* ids: edge tile k only reads
+# rows from the contiguous band of segment tiles spanned by
+# ids[k·TE .. (k+1)·TE).  A tile holds 128 edges, so the band covers at most
+# 128 segment tiles and for dense-ish sorted ids (the builder's layout)
+# typically one or two; the grid's band dimension spans the worst case and
+# runtime-skips past each tile's actual band, with the block index frozen so
+# the repeated copies are elided.  The backward of the banded segment sum
+# therefore stays linear as well (the dense gather would hand the quadratic
+# cost right back in training, where ~2/3 of the FLOPs live).
+
+
+def _gather_sorted_kernel(s0_ref, s1_ref, nt_ref, idx_ref, table_ref, out_ref):
+    k = pl.program_id(0)
+    b = pl.program_id(2)
+
+    @pl.when(b == 0)
+    def _():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    @pl.when((s0_ref[k] + b < s1_ref[k]) & (s0_ref[k] + b < nt_ref[0]))
+    def _():
+        _gather_onehot(idx_ref, table_ref, out_ref, (s0_ref[k] + b) * _TN)
+
+
+def _gather_sorted_call(
+    table: jnp.ndarray, idx: jnp.ndarray, *, interpret: bool = False
+) -> jnp.ndarray:
+    """Row gather ``table[idx]`` for nondecreasing ``idx``."""
+    N, F = table.shape
+    E = idx.shape[0]
+    if E == 0 or F == 0 or N == 0:  # degenerate: nothing to tile
+        return jnp.zeros((E, F), table.dtype)
+    n_pad = N + ((-N) % _TN)
+    # pad ids with n_pad: keeps the vector sorted, matches no table row
+    ids = _pad_to(idx.astype(jnp.int32).reshape(-1, 1), 0, _TE, n_pad)
+    tab = _pad_to(_pad_to(table, 0, _TN, 0), 1, _TF, 0)
+    Ep = ids.shape[0]
+    Np, Fp = tab.shape
+    e_tiles, f_tiles, n_tiles = Ep // _TE, Fp // _TF, Np // _TN
+
+    # per-edge-tile band of segment tiles: [s0, s1); width is typically 1-2
+    # for dense-ish sorted ids but can reach min(TE, n_tiles) when sparse,
+    # so the grid spans the worst case and runtime-skips the rest
+    first = ids[::_TE, 0]
+    last = ids[_TE - 1::_TE, 0]
+    s0 = (first // _TN).astype(jnp.int32)
+    s1 = (last // _TN + 1).astype(jnp.int32)
+    nt = jnp.full((1,), n_tiles, jnp.int32)
+
+    def _seg_tile(k, b, s0r, s1r, ntr):
+        # freeze on the band's last tile once b passes it (identical block
+        # indices → elided copies); the final clamp keeps all-pad edge
+        # tiles (whose band starts at n_tiles) inside the valid range
+        return jnp.minimum(
+            jnp.minimum(s0r[k] + b, jnp.maximum(s1r[k] - 1, s0r[k])),
+            ntr[0] - 1)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(e_tiles, f_tiles, n_tiles),
+        in_specs=[
+            pl.BlockSpec((_TE, 1), lambda k, j, b, s0r, s1r, ntr: (k, 0)),
+            pl.BlockSpec((_TN, _TF),
+                         lambda k, j, b, s0r, s1r, ntr:
+                         (_seg_tile(k, b, s0r, s1r, ntr), j)),
+        ],
+        out_specs=pl.BlockSpec((_TE, _TF),
+                               lambda k, j, b, s0r, s1r, ntr: (k, j)),
+    )
+    out = pl.pallas_call(
+        _gather_sorted_kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((Ep, Fp), jnp.float32),
+        # typical-case banded cost (band ≈ 2 segment tiles per edge tile)
+        cost_estimate=pl.CostEstimate(
+            flops=2 * 2 * _TN * Ep * Fp,
+            bytes_accessed=4 * (2 * Ep * _TN // _TE * Fp + Ep * Fp) + 4 * Ep,
+            transcendentals=0,
+        ),
+        interpret=interpret,
+    )(s0, s1, nt, ids, tab)
+    return out[:E, :F].astype(table.dtype)
+
+
 # --- row gather --------------------------------------------------------------
+
+
+def _gather_onehot(idx_ref, table_ref, out_ref, row_base):
+    """out += onehot(idx, row_base..row_base+TN) @ table — the shared MXU
+    body of both gather kernels."""
+    idx = idx_ref[:]  # [TE, 1] int32
+    cols = jax.lax.broadcasted_iota(jnp.int32, (_TE, _TN), 1) + row_base
+    onehot = (idx == cols).astype(jnp.float32)  # [TE, TN]
+    out_ref[:] += jnp.dot(
+        onehot, table_ref[:].astype(jnp.float32), preferred_element_type=jnp.float32
+    )
 
 
 def _gather_kernel(idx_ref, table_ref, out_ref):
@@ -204,13 +309,7 @@ def _gather_kernel(idx_ref, table_ref, out_ref):
     def _():
         out_ref[:] = jnp.zeros_like(out_ref)
 
-    row_base = pl.program_id(2) * _TN
-    idx = idx_ref[:]  # [TE, 1] int32
-    cols = jax.lax.broadcasted_iota(jnp.int32, (_TE, _TN), 1) + row_base
-    onehot = (idx == cols).astype(jnp.float32)  # [TE, TN]
-    out_ref[:] += jnp.dot(
-        onehot, table_ref[:].astype(jnp.float32), preferred_element_type=jnp.float32
-    )
+    _gather_onehot(idx_ref, table_ref, out_ref, pl.program_id(2) * _TN)
 
 
 def _gather_call(
@@ -284,7 +383,13 @@ def _segment_sum_sorted_fwd(data, segment_ids, num_segments, interpret):
         data, segment_ids, num_segments, interpret=interpret), (segment_ids,)
 
 
-segment_sum_sorted.defvjp(_segment_sum_sorted_fwd, _segment_sum_bwd)
+def _segment_sum_sorted_bwd(num_segments, interpret, res, g):
+    (segment_ids,) = res
+    # adjoint is a gather by the same nondecreasing ids — banded too
+    return _gather_sorted_call(g, segment_ids, interpret=interpret), None
+
+
+segment_sum_sorted.defvjp(_segment_sum_sorted_fwd, _segment_sum_sorted_bwd)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2,))

@@ -2,10 +2,13 @@
 
 The graph builder emits edges sorted by destination node, so aggregation is a
 segment reduction over a monotone id vector — the memory-friendly layout for
-TPU.  This module is the single switchboard for those primitives: the default
-path is XLA's fused scatter-add (`jax.ops.segment_sum` with
-``indices_are_sorted=True``); `nerrf_tpu.ops.pallas_segment` provides a
-hand-tiled Pallas kernel for the hot TPU path and registers itself here.
+TPU.  This module is the single switchboard for those primitives.  The
+fallback path is XLA's fused scatter-add (`jax.ops.segment_sum`);
+`nerrf_tpu.ops.pallas_segment` provides hand-tiled Pallas kernels for the hot
+TPU path and registers itself here.  ``sorted_ids=True`` is a **contract**
+(ids really are nondecreasing — it routes to a banded kernel that drops
+out-of-band rows on unsorted input), not a hint; the default is the safe
+order-independent path.
 
 (The reference framework has no sparse ops at all — its AI subsystem was never
 built; this realizes the north-star requirement that neighbor-sampling and

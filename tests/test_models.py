@@ -53,6 +53,34 @@ def test_graphsage_forward_shapes_and_masking():
     assert np.isfinite(np.asarray(out["edge_logit"])).all()
 
 
+def test_graphsage_rev_view_matches_unsorted_path():
+    """The src-sorted reverse-aggregation view is a pure reordering: node
+    outputs must match the unsorted segment path up to float summation
+    order (it exists so both directions ride the banded Pallas kernel)."""
+    from nerrf_tpu.models.graphsage import SageBlock
+
+    ds = _dataset()
+    a = ds.arrays
+    rng = np.random.default_rng(5)
+    h = jnp.asarray(rng.normal(size=(64, 16)), jnp.float32)
+    e_emb = jnp.asarray(rng.normal(size=(128, 16)), jnp.float32)
+    src = a["edge_src"][0]
+    dst = a["edge_dst"][0]
+    w = jnp.asarray(rng.uniform(0.1, 1.0, 128), jnp.float32)
+
+    block = SageBlock(16, dtype=jnp.float32)
+    params = block.init(jax.random.PRNGKey(1), h, e_emb, src, dst, w, 64)["params"]
+    plain = block.apply({"params": params}, h, e_emb, src, dst, w, 64)
+
+    order = jnp.argsort(src)
+    rev_view = (jnp.take(src, order), jnp.take(dst, order),
+                jnp.take(e_emb, order, axis=0), jnp.take(w, order))
+    viewed = block.apply({"params": params}, h, e_emb, src, dst, w, 64,
+                         rev_view=rev_view)
+    np.testing.assert_allclose(np.asarray(plain), np.asarray(viewed),
+                               rtol=1e-4, atol=1e-5)
+
+
 def test_graphsage_param_count_matches_spec():
     """Spec: ~28 layers, ~2M params (architecture.mdx:52)."""
     ds = _dataset()

@@ -108,6 +108,35 @@ def test_sorted_segment_sum_grad_is_gather():
     np.testing.assert_allclose(g, want, rtol=1e-5, atol=1e-5)
 
 
+def test_sorted_gather_matches_take_sparse_spread():
+    # nondecreasing ids whose 128-edge tiles each SPAN many segment tiles
+    # (sparse ids) — the band is wide, not the ≤2 tiles of dense layouts
+    N, F, E = 2000, 10, 256
+    ids = jnp.asarray(
+        np.sort(np.random.default_rng(33).integers(0, N, E)), jnp.int32)
+    table = _rand((N, F), 34)
+    got = pallas_segment._gather_sorted_call(table, ids, interpret=True)
+    np.testing.assert_allclose(got, jnp.take(table, ids, axis=0),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_sorted_segment_sum_grad_sparse_spread():
+    # backward = banded gather; sparse sorted ids exercise wide bands
+    N, F, E = 2000, 6, 256
+    ids = jnp.asarray(
+        np.sort(np.random.default_rng(35).integers(0, N, E)), jnp.int32)
+    data = _rand((E, F), 36)
+
+    def loss(d):
+        return jnp.sum(pallas_segment.segment_sum_sorted(d, ids, N, True) ** 2)
+
+    g = jax.grad(loss)(data)
+    want = jax.grad(
+        lambda d: jnp.sum(jax.ops.segment_sum(d, ids, num_segments=N) ** 2)
+    )(data)
+    np.testing.assert_allclose(g, want, rtol=1e-5, atol=1e-5)
+
+
 def test_switchboard_routes_sorted_calls_to_banded_kernel(monkeypatch):
     pallas_segment.register(interpret=True)
     calls = []
