@@ -17,9 +17,15 @@ while [ ! -f datasets/corpus100/manifest.json ]; do
   log "waiting for corpus100 generation"; sleep 60
 done
 log "1/5 joint-100h training"
-timeout 3600 python -m nerrf_tpu.train.run --experiment joint-100h \
-  --out runs/joint-100h-r2 --ckpt-every 2000 > /tmp/joint100.log 2>&1
-log "joint-100h rc=$?"
+# both prior tunnel wedges struck during this step's shard upload (now
+# chunked); resume-from-checkpoint makes one retry cheap
+for attempt in 1 2; do
+  timeout 3600 python -m nerrf_tpu.train.run --experiment joint-100h \
+    --out runs/joint-100h-r2 --ckpt-every 2000 > /tmp/joint100.log 2>&1
+  rc=$?
+  log "joint-100h attempt $attempt rc=$rc"
+  [ $rc -eq 0 ] && break
+done
 if [ -f runs/joint-100h-r2/metrics.json ]; then
   mkdir -p benchmarks/results
   cp runs/joint-100h-r2/metrics.json benchmarks/results/joint100h_r2.json
