@@ -314,6 +314,26 @@ def cmd_ingest(args) -> int:
     return 0
 
 
+def cmd_doctor(args) -> int:
+    """Environment doctor (scripts/check_env.py as a CLI surface): python
+    deps, bounded backend probe, toolchain, native libs, capture, sandbox."""
+    import runpy
+    import sys as _sys
+
+    script = Path(__file__).resolve().parents[1] / "scripts" / "check_env.py"
+    argv = ([str(script)] + (["--build"] if args.build else [])
+            + (["--json"] if args.json else []))
+    old = _sys.argv
+    _sys.argv = argv
+    try:
+        runpy.run_path(str(script), run_name="__main__")
+        return 0
+    except SystemExit as e:
+        return int(e.code or 0)
+    finally:
+        _sys.argv = old
+
+
 # --------------------------------------------------------------------------
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="nerrf", description=__doc__)
@@ -360,6 +380,14 @@ def main(argv=None) -> int:
     p.add_argument("--duration", type=float, default=0,
                    help="serve for N seconds then exit (0 = until signal)")
     p.set_defaults(fn=cmd_serve)
+
+    p = sub.add_parser("doctor", help="diagnose the environment (deps, "
+                                      "backend, toolchain, capture, sandbox)")
+    p.add_argument("--build", action="store_true",
+                   help="also build missing native libraries")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable summary")
+    p.set_defaults(fn=cmd_doctor)
 
     p = sub.add_parser("ingest", help="drain a tracker into a trace store")
     p.add_argument("--target", required=True, help="tracker host:port")

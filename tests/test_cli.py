@@ -43,3 +43,20 @@ def test_cli_status_empty(tmp_path, capsys):
     assert main(["status", "--incident", str(tmp_path / "nothing")]) == 0
     st = json.loads(capsys.readouterr().out)
     assert st["state"] == "empty"
+
+
+def test_cli_doctor_wiring(monkeypatch):
+    """`nerrf doctor` dispatches to scripts/check_env.py with flags passed
+    through (the doctor itself is exercised by its own script tests)."""
+    seen = {}
+
+    def fake_run_path(path, run_name=None):
+        import sys as _s
+        seen["script"] = path
+        seen["argv"] = list(_s.argv)
+        raise SystemExit(0)
+
+    monkeypatch.setattr("runpy.run_path", fake_run_path)
+    assert main(["doctor", "--build", "--json"]) == 0
+    assert seen["script"].endswith("check_env.py")
+    assert "--build" in seen["argv"] and "--json" in seen["argv"]
