@@ -171,6 +171,8 @@ def main() -> None:
         log(f"[bench] stream leg failed: {e!r}")
 
     rollouts_per_sec = None
+    device_rollouts_per_sec = None
+    vnet = None
     try:  # planner leg must never sink the bench's training metrics
         vnet = ValueNet.create()
         vnet.fit_to_domain(domain, num_rollouts=256, steps=150)
@@ -181,7 +183,20 @@ def main() -> None:
         log(f"[bench] mcts: {plan.rollouts} rollouts @ "
             f"{plan.rollouts_per_sec:.0f}/s, {len(plan.actions)} actions")
     except Exception as e:
-        log(f"[bench] mcts leg failed: {e!r}")
+        log(f"[bench] mcts host leg failed: {e!r}")
+        vnet = None
+    try:  # single-program on-device search (no per-batch round trips)
+        from nerrf_tpu.planner import DeviceMCTS
+
+        dm = DeviceMCTS(domain, cfg=MCTSConfig(num_simulations=800),
+                        value_fn=vnet.jit_fn() if vnet else None)
+        dm.plan()  # compile
+        dplan = dm.plan()
+        device_rollouts_per_sec = dplan.rollouts_per_sec
+        log(f"[bench] mcts device: {dplan.rollouts} rollouts @ "
+            f"{dplan.rollouts_per_sec:.0f}/s, {len(dplan.actions)} actions")
+    except Exception as e:
+        log(f"[bench] mcts device leg failed: {e!r}")
 
     # --- torch baseline (same architecture, this host) ----------------------
     vs_baseline = None
@@ -246,6 +261,9 @@ def main() -> None:
         "seq_f1": round(metrics["seq_f1"], 4),
         "mcts_rollouts_per_sec":
             round(rollouts_per_sec, 1) if rollouts_per_sec else None,
+        "mcts_device_rollouts_per_sec":
+            round(device_rollouts_per_sec, 1)
+            if device_rollouts_per_sec else None,
         "stream_events_per_sec":
             round(stream_events_per_sec) if stream_events_per_sec else None,
         "torch_cpu_steps_per_sec": round(torch_sps, 3) if torch_sps else None,

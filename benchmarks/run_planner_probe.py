@@ -31,8 +31,19 @@ def main() -> int:
     for bs in (64, 128):
         plan = MCTSPlanner(domain, vnet, MCTSConfig(
             num_simulations=800, batch_size=bs)).plan()
-        print(f"batch {bs}: {plan.rollouts} rollouts @ "
+        print(f"host batch {bs}: {plan.rollouts} rollouts @ "
               f"{plan.rollouts_per_sec:.0f}/s, {len(plan.actions)} actions")
+
+    # single-program planner: tree + search on device, no per-batch round
+    # trips (the r1-measured dominant cost over the remote-dispatch link)
+    from nerrf_tpu.planner import DeviceMCTS
+
+    dm = DeviceMCTS(domain, cfg=MCTSConfig(num_simulations=800),
+                    value_fn=vnet.jit_fn())
+    dm.plan()  # compile
+    plan = dm.plan()
+    print(f"device single-program: {plan.rollouts} rollouts @ "
+          f"{plan.rollouts_per_sec:.0f}/s, {len(plan.actions)} actions")
     return 0
 
 

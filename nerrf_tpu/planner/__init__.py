@@ -1,3 +1,4 @@
+from nerrf_tpu.planner.device_mcts import DeviceMCTS
 from nerrf_tpu.planner.domain import UndoAction, UndoDomain, UndoPlan, ActionKind
 from nerrf_tpu.planner.mcts import MCTSConfig, MCTSPlanner
 
@@ -8,4 +9,5 @@ __all__ = [
     "ActionKind",
     "MCTSConfig",
     "MCTSPlanner",
+    "DeviceMCTS",
 ]

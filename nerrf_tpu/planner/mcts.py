@@ -187,40 +187,51 @@ class MCTSPlanner:
         #    search didn't fully explore (ranked by expected gain), so the
         #    plan covers every flagged target even at modest budgets — the
         #    spec's "ranked undo candidates" (architecture.mdx:63-69).
-        actions = []
-        taken: set[int] = set()
-        i = root
-        # below this visit mass the argmax is exploration noise, not a
-        # decision — hand over to the expected-gain ranking instead
-        min_visits = max(4, sims // 100)
-        for _ in range(cfg.plan_actions):
-            kids = self.children[i]
-            counts = np.where(kids >= 0, self.visits[np.maximum(kids, 0)], 0)
-            if counts.max() < min_visits:
-                break
-            a = int(np.argmax(counts))
-            info = self.d.action_info(a)
-            if info.kind.name == "STOP":
-                break
-            if a not in taken:
-                actions.append(info)
-                taken.add(a)
-            i = int(kids[a])
-            if self.is_terminal[i] or not self.expanded[i]:
-                break
-        gains = self.d.expected_gains()
-        for a in np.argsort(-gains):
-            if len(actions) >= cfg.plan_actions:
-                break
-            if int(a) in taken or gains[a] <= 0 or int(a) == self.d.A - 1:
-                continue
-            actions.append(self.d.action_info(int(a)))
-            taken.add(int(a))
-        root_value = self.value_sum[root] / max(self.visits[root], 1)
-        return UndoPlan(
-            actions=actions,
-            expected_reward=float(root_value),
-            rollouts=sims,
-            rollouts_per_sec=sims / elapsed if elapsed > 0 else 0.0,
-            planning_seconds=elapsed,
+        return extract_plan(
+            self.d, cfg, children=self.children, visits=self.visits,
+            value_sum=self.value_sum, is_terminal=self.is_terminal,
+            expanded=self.expanded, sims=sims, elapsed=elapsed, root=root,
         )
+
+
+def extract_plan(domain, cfg, *, children, visits, value_sum, is_terminal,
+                 expanded, sims, elapsed, root=0) -> UndoPlan:
+    """Ranked plan from a searched tree (shared by the host planner and the
+    on-device planner — both produce the same array family)."""
+    actions = []
+    taken: set[int] = set()
+    i = root
+    # below this visit mass the argmax is exploration noise, not a
+    # decision — hand over to the expected-gain ranking instead
+    min_visits = max(4, sims // 100)
+    for _ in range(cfg.plan_actions):
+        kids = children[i]
+        counts = np.where(kids >= 0, visits[np.maximum(kids, 0)], 0)
+        if counts.max() < min_visits:
+            break
+        a = int(np.argmax(counts))
+        info = domain.action_info(a)
+        if info.kind.name == "STOP":
+            break
+        if a not in taken:
+            actions.append(info)
+            taken.add(a)
+        i = int(kids[a])
+        if is_terminal[i] or not expanded[i]:
+            break
+    gains = domain.expected_gains()
+    for a in np.argsort(-gains):
+        if len(actions) >= cfg.plan_actions:
+            break
+        if int(a) in taken or gains[a] <= 0 or int(a) == domain.A - 1:
+            continue
+        actions.append(domain.action_info(int(a)))
+        taken.add(int(a))
+    root_value = value_sum[root] / max(visits[root], 1)
+    return UndoPlan(
+        actions=actions,
+        expected_reward=float(root_value),
+        rollouts=sims,
+        rollouts_per_sec=sims / elapsed if elapsed > 0 else 0.0,
+        planning_seconds=elapsed,
+    )

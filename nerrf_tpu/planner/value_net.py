@@ -83,6 +83,14 @@ class ValueNet:
         the device round trip; resolve with np.asarray(result)."""
         return self._apply(self.params, jnp.asarray(features))
 
+    def jit_fn(self) -> Callable:
+        """Params-bound, jit-composable evaluator ([.., 8] → [..]) — the
+        public form DeviceMCTS (or any compiled caller) embeds in its own
+        program."""
+        params = self.params
+        apply = self._apply
+        return lambda features: apply(params, features)
+
     def fit_to_domain(
         self,
         domain: UndoDomain,

@@ -104,3 +104,96 @@ def test_mcts_all_clean_prefers_stopping():
     plan = MCTSPlanner(d, HeuristicValue(), MCTSConfig(num_simulations=300,
                                                        batch_size=16)).plan()
     assert len(plan.actions) == 0
+
+
+# --- on-device single-program MCTS ------------------------------------------
+
+
+def test_device_step_matches_numpy_domain():
+    """DeviceMCTS._step is a branchless re-expression of
+    UndoDomain.step_batch — must agree on every action from random states."""
+    import jax.numpy as jnp
+
+    from nerrf_tpu.planner import DeviceMCTS
+
+    d = _domain(seed=3)
+    dm = DeviceMCTS(d, cfg=MCTSConfig(num_simulations=8))
+    rng = np.random.default_rng(4)
+    s = d.initial_state()
+    # walk a random trajectory, cross-checking every transition
+    for step in range(10):
+        legal = d.legal_actions(s[None])[0]
+        if not legal.any():
+            break
+        a = int(rng.choice(np.flatnonzero(legal)))
+        want_s, want_r = d.step_batch(s[None].copy(), np.array([a]))
+        got_s, got_r = dm._step(jnp.asarray(s), jnp.asarray(a))
+        np.testing.assert_allclose(np.asarray(got_s), want_s[0],
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(float(got_r), want_r[0],
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_array_equal(
+            np.asarray(dm._legal(jnp.asarray(want_s[0]))),
+            d.legal_actions(want_s)[0])
+        assert bool(dm._terminal(jnp.asarray(want_s[0]))) == bool(
+            d.terminal(want_s)[0])
+        fw = np.asarray(dm._features(jnp.asarray(want_s[0])))
+        np.testing.assert_allclose(fw, d.value_features(want_s)[0],
+                                   rtol=1e-5, atol=1e-6)
+        s = want_s[0]
+
+
+def test_device_mcts_plan_matches_host_targets():
+    from nerrf_tpu.planner import DeviceMCTS
+
+    d = _domain(seed=1)
+    host = MCTSPlanner(d, cfg=MCTSConfig(num_simulations=300, batch_size=32))
+    hplan = host.plan()
+    dev = DeviceMCTS(d, cfg=MCTSConfig(num_simulations=300))
+    dplan = dev.plan()
+    assert dplan.rollouts == 300
+    # both planners must flag every clearly-compromised file
+    compromised = {f"/app/uploads/f_{i}.lockbit3"
+                   for i in range(d.F) if d.file_scores[i] > 0.5}
+    dev_targets = {a.target for a in dplan.actions}
+    assert compromised <= dev_targets
+    host_targets = {a.target for a in hplan.actions}
+    assert compromised <= host_targets
+    # and the hot process
+    assert any(a.kind == ActionKind.KILL_PROCESS and a.score > 0.9
+               for a in dplan.actions)
+
+
+def test_device_mcts_deterministic():
+    from nerrf_tpu.planner import DeviceMCTS
+
+    d = _domain(seed=2)
+    dev = DeviceMCTS(d, cfg=MCTSConfig(num_simulations=100))
+    p1, p2 = dev.plan(), dev.plan()
+    assert [a.target for a in p1.actions] == [a.target for a in p2.actions]
+    assert p1.expected_reward == p2.expected_reward
+
+
+def test_device_mcts_terminal_root():
+    """A root that is already stopped must not grow the tree."""
+    import jax.numpy as jnp
+
+    from nerrf_tpu.planner import DeviceMCTS
+
+    d = _domain()
+    dev = DeviceMCTS(d, cfg=MCTSConfig(num_simulations=20))
+    s = d.initial_state()
+    s[d.F + d.P + 2] = 1.0  # stopped
+    tree = dev._search(jnp.asarray(s))
+    assert int(tree.n_nodes) == 1
+
+
+def test_device_mcts_respects_wall_clock_budget():
+    from nerrf_tpu.planner import DeviceMCTS
+
+    d = _domain()
+    dev = DeviceMCTS(d, cfg=MCTSConfig(num_simulations=5000,
+                                       timeout_seconds=0.0))
+    plan = dev.plan()
+    # budget of zero: exactly one compiled chunk runs, then the check trips
+    assert plan.rollouts <= 128
