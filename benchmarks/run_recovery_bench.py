@@ -35,6 +35,7 @@ def main() -> int:
     ap.add_argument("--scale", choices=["m0", "m1"], default="m1")
     ap.add_argument("--out", default=None)
     ap.add_argument("--simulations", type=int, default=800)
+    ap.add_argument("--planner", choices=("host", "device"), default="host")
     args = ap.parse_args()
 
     from nerrf_tpu.pipeline import build_undo_domain, heuristic_detect
@@ -81,8 +82,10 @@ def main() -> int:
         domain = build_undo_domain(detection, manifest, root=str(victim))
         value = ValueNet.create()
         value.fit_to_domain(domain, num_rollouts=256, horizon=32, steps=200)
-        plan = MCTSPlanner(domain, value, MCTSConfig(
-            num_simulations=args.simulations)).plan()
+        from nerrf_tpu.planner import make_planner
+
+        plan = make_planner(domain, value, MCTSConfig(
+            num_simulations=args.simulations), kind=args.planner).plan()
         t_plan = time.perf_counter() - t0 - t_detect
 
         gate = SandboxGate(store, manifest).rehearse(plan, victim, trace=trace)
@@ -135,6 +138,7 @@ def main() -> int:
                 "plan_seconds": round(t_plan, 3),
                 "gate_seconds": round(t_gate, 3),
                 "rollouts_per_sec": round(plan.rollouts_per_sec, 1),
+                "planner": args.planner,
             },
             "reference_m1_recovery": {
                 "note": "reference rename-back loop on intact plaintext "

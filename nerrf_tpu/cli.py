@@ -139,8 +139,10 @@ def cmd_undo(args) -> int:
     domain = build_undo_domain(detection, manifest, root=str(victim))
     value = ValueNet.create()
     value.fit_to_domain(domain, num_rollouts=256, horizon=32, steps=200)
-    plan = MCTSPlanner(domain, value, MCTSConfig(
-        num_simulations=args.simulations)).plan()
+    from nerrf_tpu.planner import make_planner
+
+    plan = make_planner(domain, value, MCTSConfig(
+        num_simulations=args.simulations), kind=args.planner).plan()
     (inc / "plan.json").write_text(json.dumps(plan.to_dict(), indent=2))
     _log(f"plan: {len(plan.actions)} actions, {plan.rollouts} rollouts "
          f"@ {plan.rollouts_per_sec:.0f}/s")
@@ -362,6 +364,10 @@ def main(argv=None) -> int:
     p.add_argument("--model-dir", default=None,
                    help="trained detector checkpoint (default: heuristic)")
     p.add_argument("--simulations", type=int, default=800)
+    p.add_argument("--planner", choices=("host", "device"), default="host",
+                   help="host = batched-leaf MCTS; device = whole search "
+                        "compiled on the accelerator (no per-batch round "
+                        "trips)")
     p.add_argument("--dry-run", action="store_true")
     p.add_argument("--no-gate", action="store_true")
     p.set_defaults(fn=cmd_undo)

@@ -2,7 +2,24 @@ from nerrf_tpu.planner.device_mcts import DeviceMCTS
 from nerrf_tpu.planner.domain import UndoAction, UndoDomain, UndoPlan, ActionKind
 from nerrf_tpu.planner.mcts import MCTSConfig, MCTSPlanner
 
+
+def make_planner(domain, value, cfg: MCTSConfig, kind: str = "host"):
+    """One constructor for both planner families.
+
+    ``kind='host'`` → batched-leaf :class:`MCTSPlanner` (``value`` used as
+    the batch evaluator); ``kind='device'`` → single-program
+    :class:`DeviceMCTS` (``value.jit_fn()`` embedded in the compiled
+    search).  ``value=None`` falls back to the heuristic either way."""
+    if kind == "device":
+        return DeviceMCTS(domain, cfg,
+                          value_fn=value.jit_fn() if value else None)
+    if kind != "host":
+        raise ValueError(f"unknown planner kind {kind!r}")
+    return MCTSPlanner(domain, value, cfg)
+
+
 __all__ = [
+    "make_planner",
     "UndoAction",
     "UndoDomain",
     "UndoPlan",
