@@ -39,7 +39,7 @@ def main() -> int:
     args = ap.parse_args()
 
     from nerrf_tpu.pipeline import build_undo_domain, heuristic_detect
-    from nerrf_tpu.planner import MCTSConfig, MCTSPlanner
+    from nerrf_tpu.planner import MCTSConfig, make_planner
     from nerrf_tpu.planner.value_net import ValueNet
     from nerrf_tpu.rollback import (
         FileSimConfig,
@@ -82,8 +82,6 @@ def main() -> int:
         domain = build_undo_domain(detection, manifest, root=str(victim))
         value = ValueNet.create()
         value.fit_to_domain(domain, num_rollouts=256, horizon=32, steps=200)
-        from nerrf_tpu.planner import make_planner
-
         plan = make_planner(domain, value, MCTSConfig(
             num_simulations=args.simulations), kind=args.planner).plan()
         t_plan = time.perf_counter() - t0 - t_detect

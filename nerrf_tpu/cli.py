@@ -108,7 +108,7 @@ def cmd_train_detector(args) -> int:
 def cmd_undo(args) -> int:
     from nerrf_tpu.data.loaders import load_trace_jsonl
     from nerrf_tpu.pipeline import build_undo_domain, heuristic_detect, model_detect
-    from nerrf_tpu.planner import MCTSConfig, MCTSPlanner
+    from nerrf_tpu.planner import MCTSConfig, make_planner
     from nerrf_tpu.planner.value_net import ValueNet
     from nerrf_tpu.rollback import RollbackExecutor, SandboxGate, SnapshotStore
 
@@ -139,8 +139,6 @@ def cmd_undo(args) -> int:
     domain = build_undo_domain(detection, manifest, root=str(victim))
     value = ValueNet.create()
     value.fit_to_domain(domain, num_rollouts=256, horizon=32, steps=200)
-    from nerrf_tpu.planner import make_planner
-
     plan = make_planner(domain, value, MCTSConfig(
         num_simulations=args.simulations), kind=args.planner).plan()
     (inc / "plan.json").write_text(json.dumps(plan.to_dict(), indent=2))
