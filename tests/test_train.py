@@ -98,3 +98,28 @@ def test_train_end_to_end_quality_gate():
     assert m["seq_auc"] >= 0.90, m
     assert m["seq_f1"] >= 0.95, m
     assert result.steps_per_sec > 0.5
+
+
+def test_evaluate_resident_matches_host_slicing(small_dataset):
+    """Device-resident eval (one upload + index-driven batches) must produce
+    identical metrics to the per-batch host-slicing path, including the
+    clamped partial tail batch."""
+    import jax
+
+    from nerrf_tpu.models import NerrfNet
+    from nerrf_tpu.train.loop import evaluate, init_state, make_eval_fn
+
+    ds = small_dataset
+    bs = max(2, len(ds) // 3)  # pick a size that leaves a partial tail batch
+    while len(ds) % bs == 0:
+        bs += 1
+    assert len(ds) % bs != 0
+    cfg = TrainConfig(model=JointConfig().small, num_steps=2)
+    model = NerrfNet(cfg.model)
+    state = init_state(model, cfg, ds.arrays, jax.random.PRNGKey(0))
+    fn = make_eval_fn(model)
+    host = evaluate(fn, state.params, ds, batch_size=bs, resident=False)
+    res = evaluate(fn, state.params, ds, batch_size=bs, resident=True)
+    assert host.keys() == res.keys()
+    for k in host:
+        np.testing.assert_allclose(host[k], res[k], rtol=1e-5, atol=1e-6)
