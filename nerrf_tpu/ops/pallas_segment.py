@@ -29,6 +29,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -413,18 +414,52 @@ gather_rows.defvjp(_gather_fwd, _gather_bwd)
 # --- registration ------------------------------------------------------------
 
 
+def _sorted_kernels_compile(interpret: bool) -> bool:
+    """Compile-probe the banded kernels (fwd + banded adjoint, under vmap
+    and grad, on small smoke shapes).  The scalar-prefetch grid is newer
+    Mosaic surface than the dense kernels; if this backend rejects it, the
+    switchboard must fall back to dense rather than sink every training
+    path at first step.  A smoke probe can't rule out shape-specific
+    rejections — NERRF_NO_SORTED_PALLAS=1 remains the hard escape hatch."""
+    if interpret:  # interpreter mode can't hit Mosaic rejection
+        return True
+    try:
+        ids = jnp.asarray(np.sort(np.random.default_rng(0).integers(
+            0, 64, (2, 160))), jnp.int32)
+        data = jnp.asarray(np.random.default_rng(1).normal(
+            size=(2, 160, 8)), jnp.float32)
+
+        def loss(d):
+            out = jax.vmap(
+                lambda dd, ii: segment_sum_sorted(dd, ii, 64, interpret)
+            )(d, ids)
+            return jnp.sum(out * out)
+
+        jax.block_until_ready(jax.jit(jax.grad(loss))(data))
+        return True
+    except Exception as e:
+        import sys
+
+        print(f"[nerrf_tpu.ops] banded sorted-segment kernels unavailable "
+              f"on this backend ({type(e).__name__}: {e}); using the dense "
+              "one-hot kernels for sorted calls too", file=sys.stderr)
+        return False
+
+
 def register(interpret: bool = False) -> None:
     """Install the Pallas kernels behind ``nerrf_tpu.ops``' switchboard.
 
     ``NERRF_NO_SORTED_PALLAS=1`` withholds the banded sorted kernel (dense
-    one-hot then serves sorted calls too) — an escape hatch while the
-    compiled scalar-prefetch path gets its first runs on real chips."""
+    one-hot then serves sorted calls too); otherwise the banded pair is
+    compile-probed on this backend first and dropped silently if Mosaic
+    rejects it."""
     import os
 
     from nerrf_tpu.ops import segment as _seg
 
     sorted_fn = None
-    if os.environ.get("NERRF_NO_SORTED_PALLAS") != "1":
+    if (os.environ.get("NERRF_NO_SORTED_PALLAS") != "1"
+            and _sorted_kernels_compile(interpret)):
         sorted_fn = lambda data, ids, n: segment_sum_sorted(
             data, ids, n, interpret)
     _seg.use_pallas(

@@ -248,6 +248,14 @@ def make_eval_fn(model: NerrfNet):
             lambda *args: model.apply({"params": params}, *args, deterministic=True)
         )(*model_inputs(batch))
 
+    # indexed variant for device-resident evaluation; an attribute (not a
+    # global cache) so the compiled executable's lifetime is the eval_fn's
+    @jax.jit
+    def indexed(params, idx, data):
+        batch = {k: jnp.take(v, idx, axis=0) for k, v in data.items()}
+        return eval_fn(params, batch)
+
+    eval_fn.indexed = indexed
     return eval_fn
 
 
@@ -272,28 +280,6 @@ def init_state(
     )
 
 
-_INDEXED_EVAL_CACHE: "weakref.WeakKeyDictionary" = None  # built lazily
-
-
-def _indexed_eval_fn(eval_fn):
-    """Jitted gather+eval, cached per eval_fn so repeated evaluate() calls
-    (e.g. one per adversarial scenario) compile once per process."""
-    global _INDEXED_EVAL_CACHE
-    import weakref
-
-    if _INDEXED_EVAL_CACHE is None:
-        _INDEXED_EVAL_CACHE = weakref.WeakKeyDictionary()
-    fn = _INDEXED_EVAL_CACHE.get(eval_fn)
-    if fn is None:
-        @jax.jit
-        def fn(p, idx, data):
-            batch = {k: jnp.take(v, idx, axis=0) for k, v in data.items()}
-            return eval_fn(p, batch)
-
-        _INDEXED_EVAL_CACHE[eval_fn] = fn
-    return fn
-
-
 def evaluate(eval_fn, params, ds: WindowDataset, batch_size: int = 8,
              resident: Optional[bool] = None) -> Dict[str, float]:
     """Masked metrics over a dataset.
@@ -315,7 +301,13 @@ def evaluate(eval_fn, params, ds: WindowDataset, batch_size: int = 8,
     if resident:
         dev_data = device_put_chunked(
             {k: v for k, v in ds.arrays.items() if k in _MODEL_INPUTS})
-        eval_idx = _indexed_eval_fn(eval_fn)
+        eval_idx = getattr(eval_fn, "indexed", None)
+        if eval_idx is None:  # bare callable: build (uncached) locally
+
+            @jax.jit
+            def eval_idx(p, idx, data):
+                batch = {k: jnp.take(v, idx, axis=0) for k, v in data.items()}
+                return eval_fn(p, batch)
 
     edge_scores, edge_labels = [], []
     node_scores, node_labels = [], []
@@ -409,6 +401,10 @@ def train_nerrfnet(
     metrics = evaluate(
         eval_fn, state.params, eval_ds if eval_ds is not None else train_ds,
         cfg.batch_size,
+        # evaluating the train set: its arrays are already device-resident
+        # in the train-step closure — a second resident upload would double
+        # HBM, so stream per batch in that (diagnostic) case
+        resident=None if eval_ds is not None else False,
     )
     return TrainResult(state=state, metrics=metrics, steps_per_sec=steps_per_sec,
                        history=history)
