@@ -140,6 +140,9 @@ def main(argv=None) -> int:
                          "sitecustomize on this host, jax.config can")
     args = ap.parse_args(argv)
 
+    from nerrf_tpu.utils import enable_compilation_cache
+
+    enable_compilation_cache()
     import jax
 
     if args.platform:
@@ -178,9 +181,13 @@ def main(argv=None) -> int:
         _log(f"scenario {scenario}…")
         traces = _scenario_traces(scenario, args.traces, args.seed + 1000)
         entry = {}
-        # window-level metrics need positive labels
+        # window-level metrics need positive labels; capacities must fit the
+        # scenario's densest window or the AUC measures truncation, not the
+        # model (train/data.py fit_dataset_config)
         if scenario != "benign-mass-rename":
-            ds = build_dataset(traces)
+            from nerrf_tpu.train.data import fit_dataset_config
+
+            ds = build_dataset(traces, fit_dataset_config(traces))
             m = evaluate(eval_fn, params, ds)
             entry["edge_auc"] = round(m["edge_auc"], 4)
             entry["seq_f1"] = round(m["seq_f1"], 4)

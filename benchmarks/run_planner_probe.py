@@ -13,6 +13,9 @@ import numpy as np
 
 
 def main() -> int:
+    from nerrf_tpu.utils import enable_compilation_cache
+
+    enable_compilation_cache()
     from nerrf_tpu.planner import MCTSConfig, MCTSPlanner, UndoDomain
     from nerrf_tpu.planner.value_net import ValueNet
 
@@ -39,7 +42,7 @@ def main() -> int:
     from nerrf_tpu.planner import DeviceMCTS
 
     dm = DeviceMCTS(domain, cfg=MCTSConfig(num_simulations=800),
-                    value_fn=vnet.jit_fn())
+                    value_apply=vnet.apply_fn, value_params=vnet.params)
     dm.plan()  # compile
     plan = dm.plan()
     print(f"device single-program: {plan.rollouts} rollouts @ "

@@ -13,13 +13,21 @@
 # (--listen unix:/...) where peer-pid exclusion works (SO_PEERCRED).
 set -eu
 ADDR="${TRACKER_LISTEN_ADDR:-0.0.0.0:50051}"
+# APP defaults to the image layout; e2e.sh container mode points it at the
+# repo checkout so the exact entrypoint contract runs without docker
+APP="${NERRF_APP_ROOT:-/app}"
+MAX_SECONDS="${TRACKER_MAX_SECONDS:-0}"
 
-if /app/native/build/nerrf-trackerd --probe; then
+if "$APP/native/build/nerrf-trackerd" --probe; then
     echo "[entrypoint] live capture available — starting nerrf-trackerd"
-    exec /app/native/build/nerrf-trackerd --listen "$ADDR"
+    if [ "$MAX_SECONDS" -gt 0 ]; then
+        exec "$APP/native/build/nerrf-trackerd" --listen "$ADDR" \
+            --max-seconds "$MAX_SECONDS"
+    fi
+    exec "$APP/native/build/nerrf-trackerd" --listen "$ADDR"
 fi
 rc=$?
 echo "[entrypoint] live capture unavailable (probe rc=$rc) — replay mode"
 exec python -m nerrf_tpu.cli serve \
-    --trace /app/datasets/traces/toy_trace.csv \
-    --address "$ADDR" --metrics-port 9090 --duration 0
+    --trace "$APP/datasets/traces/toy_trace.csv" \
+    --address "$ADDR" --metrics-port 9090 --duration "$MAX_SECONDS"

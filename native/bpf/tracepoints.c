@@ -34,6 +34,17 @@ struct {
   __type(value, __u64);
 } dropped SEC(".maps");
 
+// pids whose events must not enter the stream: the daemon itself and its
+// connected gRPC clients — a subscriber's socket writes would otherwise
+// feed back as captured events, amplifying without bound (same map the
+// hand-assembled path creates; capture.cc populates it via SO_PEERCRED)
+struct {
+  __uint(type, BPF_MAP_TYPE_HASH);
+  __uint(max_entries, 256);
+  __type(key, __u32);
+  __type(value, __u32);
+} excluded SEC(".maps");
+
 // Tracepoint context for syscalls/sys_enter_*: common header then the
 // syscall id and six argument slots (format: /sys/kernel/debug/tracing/
 // events/syscalls/sys_enter_openat/format).
@@ -119,6 +130,57 @@ int nerrf_unlinkat(struct sys_enter_ctx *ctx) {
   if (!e) return 0;
   bpf_probe_read_user_str(e->path, NERRF_PATH_LEN,
                           (const char *)ctx->args[1]);
+  bpf_ringbuf_submit(e, 0);
+  return 0;
+}
+
+// ---- raw_syscalls variant -------------------------------------------------
+// Firecracker-style kernels ship without CONFIG_FTRACE_SYSCALLS, so the
+// per-syscall tracepoints above do not exist there; raw_syscalls/sys_enter
+// always does.  One program, in-kernel dispatch on the syscall id — this is
+// the program the daemon actually attaches (and the C source of truth the
+// hand-assembled fallback in src/capture.cc mirrors).  The runtime loads it
+// from the compiled object when NERRF_BPF_OBJ points at one (src/bpfobj.h).
+
+struct raw_sys_enter_ctx {
+  unsigned long long unused;
+  long id;
+  unsigned long args[6];
+};
+
+static __always_inline int excluded_pid(void) {
+  __u32 pid = bpf_get_current_pid_tgid() >> 32;
+  return bpf_map_lookup_elem(&excluded, &pid) != 0;
+}
+
+SEC("tracepoint/raw_syscalls/sys_enter")
+int nerrf_raw_dispatch(struct raw_sys_enter_ctx *ctx) {
+  // x86_64 syscall numbers (same table as src/capture.cc kSpecs)
+  long id = ctx->id;
+  __u32 sc;
+  int path_arg = -1, npath_arg = -1, bytes_arg = -1, fd_arg = -1;
+  switch (id) {
+    case 257: sc = NERRF_SC_OPENAT; path_arg = 1; break;
+    case 1:   sc = NERRF_SC_WRITE; bytes_arg = 2; fd_arg = 0; break;
+    case 82:  sc = NERRF_SC_RENAME; path_arg = 0; npath_arg = 1; break;
+    case 264: /* renameat */
+    case 316: /* renameat2 */
+              sc = NERRF_SC_RENAME; path_arg = 1; npath_arg = 3; break;
+    case 87:  sc = NERRF_SC_UNLINK; path_arg = 0; break;
+    case 263: sc = NERRF_SC_UNLINK; path_arg = 1; break;
+    default:  return 0;
+  }
+  if (excluded_pid()) return 0;
+  struct nerrf_event_record *e = reserve_event(sc);
+  if (!e) return 0;
+  if (fd_arg >= 0) e->ret_val = (__s64)ctx->args[fd_arg];
+  if (bytes_arg >= 0) e->bytes = (__u64)ctx->args[bytes_arg];
+  if (path_arg >= 0)
+    bpf_probe_read_user_str(e->path, NERRF_PATH_LEN,
+                            (const char *)ctx->args[path_arg]);
+  if (npath_arg >= 0)
+    bpf_probe_read_user_str(e->new_path, NERRF_PATH_LEN,
+                            (const char *)ctx->args[npath_arg]);
   bpf_ringbuf_submit(e, 0);
   return 0;
 }

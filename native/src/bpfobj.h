@@ -1,0 +1,236 @@
+// Minimal relocatable-ELF loader for clang-compiled BPF objects.
+//
+// Closes the capture-portability gap vs the reference's cilium/ebpf loader
+// (`/root/reference/tracker/pkg/bpf/loader.go:13-45`): when a compiled
+// `tracepoints.o` is available (built by `make bpf` on a host with clang),
+// the daemon loads THAT — clang-lowered, BTF-annotated, portable across
+// kernel ctx layouts — instead of the hand-assembled bytecode, which stays
+// as the toolchain-free fallback.  No libbpf: the subset of ELF we need is
+// ~200 lines — section headers, the symbol table, and R_BPF_64_64 map
+// relocations against ld_imm64 instructions.
+//
+// Contract with bpf/tracepoints.c: map symbols are matched BY NAME
+// ("events", "dropped", "excluded") to fds the caller already created with
+// the same specs the fallback path uses; program sections are named
+// "tracepoint/<category>/<name>".  BTF sections are ignored — map specs
+// are the caller's, which keeps this loader free of BTF parsing while
+// still running clang's codegen.
+
+#ifndef NERRF_BPFOBJ_H_
+#define NERRF_BPFOBJ_H_
+
+#include <stdint.h>
+#include <stdio.h>
+#include <string.h>
+
+#include <string>
+#include <vector>
+
+namespace nerrf {
+
+struct BpfObjMapFd {
+  const char *name;
+  int fd;
+};
+
+namespace bpfobj_detail {
+
+#pragma pack(push, 1)
+struct Ehdr {
+  uint8_t ident[16];
+  uint16_t type, machine;
+  uint32_t version;
+  uint64_t entry, phoff, shoff;
+  uint32_t flags;
+  uint16_t ehsize, phentsize, phnum, shentsize, shnum, shstrndx;
+};
+struct Shdr {
+  uint32_t name, type;
+  uint64_t flags, addr, offset, size;
+  uint32_t link, info;
+  uint64_t addralign, entsize;
+};
+struct Sym {
+  uint32_t name;
+  uint8_t info, other;
+  uint16_t shndx;
+  uint64_t value, size;
+};
+struct Rel {
+  uint64_t offset, info;
+};
+struct Rela {
+  uint64_t offset, info;
+  int64_t addend;
+};
+struct Insn {  // struct bpf_insn
+  uint8_t code;
+  uint8_t regs;  // dst:4 src:4
+  int16_t off;
+  int32_t imm;
+};
+#pragma pack(pop)
+
+constexpr uint32_t kShtProgbits = 1;
+constexpr uint32_t kShtSymtab = 2;
+constexpr uint32_t kShtRela = 4;
+constexpr uint32_t kShtRel = 9;
+constexpr uint8_t kPseudoMapFd = 1;   // BPF_PSEUDO_MAP_FD
+constexpr uint8_t kLdImm64 = 0x18;    // BPF_LD | BPF_IMM | BPF_DW
+
+inline void set_err(char *errbuf, int errlen, const char *msg) {
+  if (errbuf && errlen > 0) snprintf(errbuf, errlen, "%s", msg);
+}
+
+}  // namespace bpfobj_detail
+
+// Extract the program in `section` from the relocatable BPF object in
+// `data`, patching map-load relocations with the fds in `maps` (matched by
+// symbol name).  Returns the instructions, or empty on error (reason in
+// errbuf).  Pure parsing — no syscalls — so it is unit-testable anywhere.
+inline std::vector<bpfobj_detail::Insn> bpfobj_extract(
+    const uint8_t *data, size_t len, const char *section,
+    const std::vector<BpfObjMapFd> &maps, char *errbuf, int errlen) {
+  using namespace bpfobj_detail;
+  std::vector<Insn> out;
+  if (len < sizeof(Ehdr)) {
+    set_err(errbuf, errlen, "object too small for ELF header");
+    return out;
+  }
+  Ehdr eh;
+  memcpy(&eh, data, sizeof(eh));
+  // \x7fELF, 64-bit (class 2), little-endian (data 1), e_machine EM_BPF=247
+  if (memcmp(eh.ident, "\x7f" "ELF", 4) != 0 || eh.ident[4] != 2 ||
+      eh.ident[5] != 1) {
+    set_err(errbuf, errlen, "not a 64-bit LE ELF object");
+    return out;
+  }
+  if (eh.machine != 247) {
+    set_err(errbuf, errlen, "not an EM_BPF object");
+    return out;
+  }
+  // all bounds checks use the subtract form: `a + b > len` wraps in uint64
+  // for hostile headers (e_shoff near UINT64_MAX) and would pass the guard
+  if (eh.shoff > len || uint64_t(eh.shnum) * sizeof(Shdr) > len - eh.shoff ||
+      eh.shentsize != sizeof(Shdr)) {
+    set_err(errbuf, errlen, "section header table out of bounds");
+    return out;
+  }
+  std::vector<Shdr> sh(eh.shnum);
+  for (int i = 0; i < eh.shnum; ++i)
+    memcpy(&sh[i], data + eh.shoff + i * sizeof(Shdr), sizeof(Shdr));
+  if (eh.shstrndx >= eh.shnum) {
+    set_err(errbuf, errlen, "bad shstrndx");
+    return out;
+  }
+  const Shdr &strs = sh[eh.shstrndx];
+  auto sec_name = [&](uint32_t off) -> const char * {
+    if (strs.offset >= len || off >= len - strs.offset) return "";
+    return reinterpret_cast<const char *>(data + strs.offset + off);
+  };
+
+  int prog_idx = -1, symtab_idx = -1;
+  for (int i = 0; i < eh.shnum; ++i) {
+    if (sh[i].type == kShtProgbits && strcmp(sec_name(sh[i].name), section) == 0)
+      prog_idx = i;
+    if (sh[i].type == kShtSymtab) symtab_idx = i;
+  }
+  if (prog_idx < 0) {
+    set_err(errbuf, errlen, "program section not found in object");
+    return out;
+  }
+  const Shdr &prog = sh[prog_idx];
+  if (prog.offset > len || prog.size > len - prog.offset ||
+      prog.size % sizeof(Insn) != 0) {
+    set_err(errbuf, errlen, "program section malformed");
+    return out;
+  }
+  out.resize(prog.size / sizeof(Insn));
+  memcpy(out.data(), data + prog.offset, prog.size);
+
+  // symbol table (for relocation names)
+  std::vector<Sym> syms;
+  const char *symstr = nullptr;
+  uint64_t symstr_len = 0;
+  if (symtab_idx >= 0) {
+    const Shdr &st = sh[symtab_idx];
+    if (st.offset <= len && st.size <= len - st.offset &&
+        st.entsize == sizeof(Sym)) {
+      syms.resize(st.size / sizeof(Sym));
+      memcpy(syms.data(), data + st.offset, st.size);
+      if (st.link < eh.shnum && sh[st.link].offset <= len &&
+          sh[st.link].size <= len - sh[st.link].offset) {
+        symstr = reinterpret_cast<const char *>(data + sh[st.link].offset);
+        symstr_len = sh[st.link].size;
+      }
+    }
+  }
+  auto sym_name = [&](uint64_t idx) -> const char * {
+    if (idx >= syms.size() || !symstr) return "";
+    uint32_t off = syms[idx].name;
+    if (off >= symstr_len) return "";
+    return symstr + off;
+  };
+
+  // apply REL/RELA sections that target the program section
+  for (int i = 0; i < eh.shnum; ++i) {
+    if (sh[i].type != kShtRel && sh[i].type != kShtRela) continue;
+    if (static_cast<int>(sh[i].info) != prog_idx) continue;
+    size_t ent = sh[i].type == kShtRel ? sizeof(Rel) : sizeof(Rela);
+    if (sh[i].offset > len || sh[i].size > len - sh[i].offset ||
+        sh[i].entsize != ent) continue;
+    size_t n = sh[i].size / ent;
+    for (size_t r = 0; r < n; ++r) {
+      uint64_t offset, info;
+      memcpy(&offset, data + sh[i].offset + r * ent, 8);
+      memcpy(&info, data + sh[i].offset + r * ent + 8, 8);
+      uint64_t sym_idx = info >> 32;
+      uint64_t insn_idx = offset / sizeof(Insn);
+      if (insn_idx >= out.size()) {
+        set_err(errbuf, errlen, "relocation offset out of range");
+        return {};
+      }
+      const char *name = sym_name(sym_idx);
+      int fd = -1;
+      for (const auto &m : maps)
+        if (strcmp(m.name, name) == 0) fd = m.fd;
+      if (fd < 0) {
+        if (errbuf && errlen > 0)
+          snprintf(errbuf, errlen, "relocation against unknown map '%s'",
+                   name[0] ? name : "?");
+        return {};
+      }
+      if (out[insn_idx].code != kLdImm64 || insn_idx + 1 >= out.size()) {
+        set_err(errbuf, errlen, "relocation target is not ld_imm64");
+        return {};
+      }
+      out[insn_idx].regs = (out[insn_idx].regs & 0x0f) | (kPseudoMapFd << 4);
+      out[insn_idx].imm = fd;
+      out[insn_idx + 1].imm = 0;
+    }
+  }
+  return out;
+}
+
+// Convenience: read a file then extract.
+inline std::vector<bpfobj_detail::Insn> bpfobj_extract_file(
+    const char *path, const char *section,
+    const std::vector<BpfObjMapFd> &maps, char *errbuf, int errlen) {
+  std::vector<bpfobj_detail::Insn> out;
+  FILE *f = fopen(path, "rb");
+  if (!f) {
+    bpfobj_detail::set_err(errbuf, errlen, "cannot open BPF object file");
+    return out;
+  }
+  std::string buf;
+  char tmp[65536];
+  size_t n;
+  while ((n = fread(tmp, 1, sizeof(tmp), f)) > 0) buf.append(tmp, n);
+  fclose(f);
+  return bpfobj_extract(reinterpret_cast<const uint8_t *>(buf.data()),
+                        buf.size(), section, maps, errbuf, errlen);
+}
+
+}  // namespace nerrf
+
+#endif  // NERRF_BPFOBJ_H_

@@ -24,6 +24,7 @@
 #include <vector>
 
 #include "bpfasm.h"
+#include "bpfobj.h"
 
 // ---- minimal UAPI mirrors (no <linux/bpf.h> dependency drift) -------------
 
@@ -299,6 +300,25 @@ struct nerrf_capture {
   size_t ro_len = 0;
 };
 
+// Test hook: parse `path`, extract `section`, patch relocations against
+// fake fds (events=101, dropped=102, excluded=103), and copy up to
+// max_insns 8-byte instructions into out.  Returns the instruction count,
+// or -1 with the reason in errbuf.  Lets the Python tests validate the ELF
+// loader end-to-end without bpf(2) permissions or clang.
+extern "C" int nerrf_bpfobj_parse(const char *path, const char *section,
+                                  uint8_t *out, int max_insns, char *errbuf,
+                                  int errlen) {
+  auto insns = nerrf::bpfobj_extract_file(
+      path, section,
+      {{"events", 101}, {"dropped", 102}, {"excluded", 103}}, errbuf,
+      errlen);
+  if (insns.empty()) return -1;
+  int n = static_cast<int>(insns.size());
+  if (n > max_insns) n = max_insns;
+  memcpy(out, insns.data(), size_t(n) * 8);
+  return n;
+}
+
 extern "C" int nerrf_capture_probe(char *errbuf, int errlen) {
   if (read_tracepoint_id(nullptr, 0) <= 0) {
     set_err(errbuf, errlen, "no raw_syscalls tracepoint (tracefs/kernel)");
@@ -365,8 +385,32 @@ extern "C" nerrf_capture *nerrf_capture_open(uint32_t ringbuf_bytes,
   if (self_pid > 0) nerrf_capture_exclude_pid(c, self_pid);
 
   {
-    std::vector<nerrf::BpfInsn> insns =
-        build_program(c->events_fd, c->dropped_fd, c->exclude_fd);
+    // Program source ladder: a clang-compiled object (NERRF_BPF_OBJ, or
+    // build/tracepoints.o next to the binary) when present — portable
+    // clang codegen, same semantics — else the hand-assembled bytecode.
+    std::vector<nerrf::BpfInsn> insns;
+    const char *obj = getenv("NERRF_BPF_OBJ");
+    if (obj && obj[0]) {
+      char oerr[256] = {0};
+      auto oi = nerrf::bpfobj_extract_file(
+          obj, "tracepoint/raw_syscalls/sys_enter",
+          {{"events", c->events_fd},
+           {"dropped", c->dropped_fd},
+           {"excluded", c->exclude_fd}},
+          oerr, sizeof(oerr));
+      if (oi.empty()) {
+        if (errbuf && errlen > 0)
+          snprintf(errbuf, errlen, "NERRF_BPF_OBJ=%s unusable: %s", obj,
+                   oerr);
+        goto fail;
+      }
+      insns.resize(oi.size());
+      memcpy(insns.data(), oi.data(), oi.size() * sizeof(oi[0]));
+      fprintf(stderr, "[capture] using compiled BPF object %s (%zu insns)\n",
+              obj, insns.size());
+    } else {
+      insns = build_program(c->events_fd, c->dropped_fd, c->exclude_fd);
+    }
     static char log[65536];
     memset(&attr, 0, sizeof(attr));
     attr.prog.prog_type = kProgTypeTracepoint;

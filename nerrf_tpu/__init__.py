@@ -14,3 +14,8 @@ backend.
 """
 
 __version__ = "0.1.0"
+
+# Chip-side entry points (bench.py, train.run, the offline benchmarks)
+# opt into the persistent XLA compilation cache explicitly via
+# nerrf_tpu.utils.enable_compilation_cache() — NOT here: importing jax at
+# package import would defeat the CLI's deliberate lazy-import startup.

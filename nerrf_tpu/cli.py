@@ -329,7 +329,11 @@ def cmd_doctor(args) -> int:
         runpy.run_path(str(script), run_name="__main__")
         return 0
     except SystemExit as e:
-        return int(e.code or 0)
+        # exit codes are not always ints: argparse errors carry strings,
+        # bare sys.exit() carries None
+        if isinstance(e.code, int):
+            return e.code
+        return 0 if e.code in (None, 0) else 1
     finally:
         _sys.argv = old
 
@@ -362,10 +366,13 @@ def main(argv=None) -> int:
     p.add_argument("--model-dir", default=None,
                    help="trained detector checkpoint (default: heuristic)")
     p.add_argument("--simulations", type=int, default=800)
-    p.add_argument("--planner", choices=("host", "device"), default="host",
+    p.add_argument("--planner", choices=("auto", "host", "device"),
+                   default="auto",
                    help="host = batched-leaf MCTS; device = whole search "
                         "compiled on the accelerator (no per-batch round "
-                        "trips)")
+                        "trips); auto (default) = device when a chip is up "
+                        "— plan time dominates MTTR, so the chip is the "
+                        "KPI path")
     p.add_argument("--dry-run", action="store_true")
     p.add_argument("--no-gate", action="store_true")
     p.set_defaults(fn=cmd_undo)

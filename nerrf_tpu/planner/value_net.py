@@ -17,6 +17,7 @@ incident size without recompilation.
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Callable
 
 import flax.linen as nn
@@ -61,18 +62,35 @@ class _MLP(nn.Module):
         return nn.Dense(1)(x)[..., 0]
 
 
+@functools.lru_cache(maxsize=8)
+def _mlp_apply(hidden: int) -> Callable:
+    """The pure ``(params, features) → values`` evaluator, ONE function
+    object per hidden size.  Identity stability matters: DeviceMCTS keys
+    its compiled-search cache on this callable, so two incidents with
+    freshly fitted nets (different params, same architecture) must present
+    the same apply object to share the executable."""
+    model = _MLP(hidden)
+    return jax.jit(lambda p, x: model.apply(p, x))
+
+
 @dataclasses.dataclass
 class ValueNet:
     params: dict
     _apply: Callable
+    hidden: int = 64
 
     @classmethod
     def create(cls, rng: jax.Array | None = None, hidden: int = 64) -> "ValueNet":
         rng = rng if rng is not None else jax.random.PRNGKey(0)
         model = _MLP(hidden)
         params = model.init(rng, jnp.zeros((1, 8)))
-        apply = jax.jit(lambda p, x: model.apply(p, x))
-        return cls(params=params, _apply=apply)
+        return cls(params=params, _apply=_mlp_apply(hidden), hidden=hidden)
+
+    @property
+    def apply_fn(self) -> Callable:
+        """Stable pure apply — pass with ``self.params`` to compiled
+        consumers (DeviceMCTS ``value_apply``/``value_params``)."""
+        return self._apply
 
     def __call__(self, features: np.ndarray) -> np.ndarray:
         return np.asarray(self._apply(self.params, jnp.asarray(features)))

@@ -65,6 +65,15 @@ class CorpusSpec:
     # of attack traces drawn from each adversarial variant
     benign_hard_fraction: float = 0.2
     attack_variant_fraction: float = 0.3   # split evenly across 3 variants
+    # Zero-drop capacity fitting (r2 verdict weak #3: the r2 corpus was cut
+    # at 256n/512e while its own densest training window needed 599n/639e —
+    # attack bursts, exactly the signal, were silently truncated).  When on,
+    # generation runs a cheap measuring pre-pass over every window of every
+    # trace (re-simulating; traces are seed-deterministic), sizes capacities
+    # to the corpus-wide max via GraphConfig.fit_counts (×headroom, next
+    # pow2), then asserts the windowing pass dropped zero events.
+    auto_fit: bool = True
+    fit_headroom: float = 1.25
 
 
 def _write_shard(out: Path, samples: List[dict], dtypes: Dict[str, str]) -> int:
@@ -112,6 +121,76 @@ def generate_corpus(
     if spec.eval_fraction > 0 and n_traces >= 2 and not is_eval.any():
         is_eval[-1] = True  # small corpora must still have a held-out trace
 
+    def sim_config(i: int) -> "SimConfig":
+        """The per-trace SimConfig — pure function of (spec, i) so the
+        measuring pre-pass and the windowing pass see identical traces."""
+        trng = np.random.default_rng((spec.base_seed, i))
+        scenario = "standard"
+        if spec.hard_scenarios:
+            u = trng.random()
+            if is_attack[i]:
+                third = spec.attack_variant_fraction / 3.0
+                if u < third:
+                    scenario = "slow-drip"
+                elif u < 2 * third:
+                    scenario = "benign-comm"
+                elif u < 3 * third:
+                    scenario = "multi-process"
+            elif u < spec.benign_hard_fraction:
+                scenario = "benign-mass-rename"
+        return SimConfig(
+            num_target_files=int(trng.integers(max(4, spec.num_target_files // 2),
+                                               spec.num_target_files + 1)),
+            duration_sec=spec.duration_sec,
+            benign_rate_hz=float(trng.uniform(spec.benign_rate_hz * 0.5,
+                                              spec.benign_rate_hz * 1.5)),
+            attack_start_sec=float(trng.uniform(0.15, 0.7) * spec.duration_sec),
+            seed=spec.base_seed + i,
+            attack=bool(is_attack[i]),
+            scenario=scenario,
+        )
+
+    fit_info = None
+    if spec.auto_fit:
+        # Pass 0: measure the densest window in the whole corpus, then size
+        # graph capacities so NO window drops anything.  Re-simulating here
+        # (traces are pure functions of (spec, i)) costs ~22% of total
+        # generation wall-clock for the 100 h corpus (fit_seconds 271 of
+        # 1238 in the r3 manifest) — accepted one-time cost; buffering all
+        # ~600 traces' events to skip it would hold ~GBs on a small host.
+        from nerrf_tpu.graph.builder import measure_window, snapshot_windows
+
+        t_fit = time.time()
+        max_n = max_e = 0
+        for i in range(n_traces):
+            tr = simulate_trace(sim_config(i))
+            ev = tr.events
+            if ev.num_valid == 0:
+                continue
+            ts = ev.ts_ns[ev.valid]
+            for lo, hi in snapshot_windows(int(ts.min()), int(ts.max()),
+                                           dataset.graph):
+                n, e = measure_window(ev, lo, hi)
+                max_n, max_e = max(max_n, n), max(max_e, e)
+            if log and (i + 1) % 100 == 0:
+                log(f"fit pass: {i + 1}/{n_traces} traces, "
+                    f"max so far {max_n}n/{max_e}e")
+        fitted = dataset.graph.fit_counts(max_n, max_e,
+                                          headroom=spec.fit_headroom)
+        dataset = dataclasses.replace(dataset, graph=fitted)
+        fit_info = {
+            "max_window_nodes": max_n,
+            "max_window_edges": max_e,
+            "headroom": spec.fit_headroom,
+            "fitted_max_nodes": fitted.max_nodes,
+            "fitted_max_edges": fitted.max_edges,
+            "fit_seconds": round(time.time() - t_fit, 1),
+        }
+        if log:
+            log(f"auto-fit: densest window {max_n}n/{max_e}e → capacities "
+                f"{fitted.max_nodes}n/{fitted.max_edges}e "
+                f"({fit_info['fit_seconds']:.0f}s)")
+
     dtypes: Dict[str, str] = {}
     shards: List[dict] = []
     buf: Dict[bool, List[dict]] = {True: [], False: []}  # eval? → samples
@@ -135,37 +214,21 @@ def generate_corpus(
                     f"({time.time() - t0:.0f}s elapsed)")
 
     scenario_counts: Dict[str, int] = {}
+    drop_tally = {"events": 0, "nodes": 0, "edges": 0, "windows": 0}
     for i in range(n_traces):
         # structural variety per trace (files, load, attack onset), not just
         # the sim seed — a fixed onset would be a trivially learnable clock
-        trng = np.random.default_rng((spec.base_seed, i))
-        scenario = "standard"
-        if spec.hard_scenarios:
-            u = trng.random()
-            if is_attack[i]:
-                third = spec.attack_variant_fraction / 3.0
-                if u < third:
-                    scenario = "slow-drip"
-                elif u < 2 * third:
-                    scenario = "benign-comm"
-                elif u < 3 * third:
-                    scenario = "multi-process"
-            elif u < spec.benign_hard_fraction:
-                scenario = "benign-mass-rename"
-        scenario_counts[scenario] = scenario_counts.get(scenario, 0) + 1
-        sim = SimConfig(
-            num_target_files=int(trng.integers(max(4, spec.num_target_files // 2),
-                                               spec.num_target_files + 1)),
-            duration_sec=spec.duration_sec,
-            benign_rate_hz=float(trng.uniform(spec.benign_rate_hz * 0.5,
-                                              spec.benign_rate_hz * 1.5)),
-            attack_start_sec=float(trng.uniform(0.15, 0.7) * spec.duration_sec),
-            seed=spec.base_seed + i,
-            attack=bool(is_attack[i]),
-            scenario=scenario,
-        )
+        sim = sim_config(i)
+        scenario_counts[sim.scenario] = scenario_counts.get(sim.scenario, 0) + 1
         tr = simulate_trace(sim)
-        samples = windows_of_trace(tr, dataset)
+        wstats: list = []
+        samples = windows_of_trace(tr, dataset, stats_out=wstats)
+        for st in wstats:
+            if st.dropped_events or st.dropped_nodes or st.dropped_edges:
+                drop_tally["events"] += st.dropped_events
+                drop_tally["nodes"] += st.dropped_nodes
+                drop_tally["edges"] += st.dropped_edges
+                drop_tally["windows"] += 1
         for s in samples:
             label_pos["edge"] += float(s["edge_label"].sum())
             label_pos["seq"] += float(s["seq_label"].sum())
@@ -176,6 +239,11 @@ def generate_corpus(
                 f"({(i + 1) * spec.duration_sec / 3600:.1f}h)")
     flush(False, force=True)
     flush(True, force=True)
+    if spec.auto_fit and drop_tally["windows"]:
+        raise ValueError(
+            f"corpus windowing dropped data despite auto-fit capacities "
+            f"{dataset.graph.max_nodes}n/{dataset.graph.max_edges}e: "
+            f"{drop_tally} — fit pass and windowing pass disagree (bug)")
 
     man = {
         "complete": True,
@@ -189,6 +257,10 @@ def generate_corpus(
         "gen_seconds": round(time.time() - t0, 1),
         "label_pos": label_pos,
         "scenario_counts": scenario_counts,
+        "graph_capacity": {"max_nodes": dataset.graph.max_nodes,
+                           "max_edges": dataset.graph.max_edges},
+        "auto_fit": fit_info,
+        "dropped": drop_tally,
     }
     man_path.write_text(json.dumps(man, indent=2) + "\n")
     if log:

@@ -77,8 +77,15 @@ def _seq_node_index(g: GraphBatch, seqs: SequenceBatch) -> np.ndarray:
     return out
 
 
-def windows_of_trace(trace: Trace, cfg: DatasetConfig) -> List[dict[str, np.ndarray]]:
-    """All window samples for one trace."""
+def windows_of_trace(trace: Trace, cfg: DatasetConfig,
+                     stats_out: Optional[list] = None) -> List[dict[str, np.ndarray]]:
+    """All window samples for one trace.
+
+    ``stats_out``, when given, receives one ``WindowStats`` per *emitted*
+    sample so callers (corpus generation) can account for capacity overflow —
+    the r2 corpus was silently truncating attack-burst windows at the
+    256n/512e defaults, which is exactly the signal a detector needs.
+    """
     labels = derive_event_labels(trace)
     ev = trace.events
     if ev.num_valid == 0:
@@ -89,6 +96,8 @@ def windows_of_trace(trace: Trace, cfg: DatasetConfig) -> List[dict[str, np.ndar
         g, stats = build_window_graph(ev, trace.strings, lo, hi, cfg.graph, labels=labels)
         if stats.num_events < cfg.min_events:
             continue
+        if stats_out is not None:
+            stats_out.append(stats)
         seqs = build_file_sequences(trace, labels=labels, seq_len=cfg.seq_len,
                                     lo_ns=lo, hi_ns=hi)
         if len(seqs) > cfg.max_seqs:
@@ -110,6 +119,28 @@ def windows_of_trace(trace: Trace, cfg: DatasetConfig) -> List[dict[str, np.ndar
         )
         out.append(sample)
     return out
+
+
+def fit_dataset_config(traces: List[Trace],
+                       cfg: Optional[DatasetConfig] = None) -> DatasetConfig:
+    """A DatasetConfig whose graph capacities fit every window of ``traces``
+    with zero drops (GraphConfig.fit_counts bucket policy, corpus-wide max).
+    Evaluation datasets must use this: scoring a model on windows that
+    silently truncate the attack burst measures the truncation, not the
+    model (r2 verdict weak #3)."""
+    from nerrf_tpu.graph.builder import measure_window
+
+    cfg = cfg or DatasetConfig()
+    max_n = max_e = 0
+    for tr in traces:
+        ev = tr.events
+        if ev.num_valid == 0:
+            continue
+        ts = ev.ts_ns[ev.valid]
+        for lo, hi in snapshot_windows(int(ts.min()), int(ts.max()), cfg.graph):
+            n, e = measure_window(ev, lo, hi)
+            max_n, max_e = max(max_n, n), max(max_e, e)
+    return dataclasses.replace(cfg, graph=cfg.graph.fit_counts(max_n, max_e))
 
 
 def build_dataset(traces: List[Trace], cfg: Optional[DatasetConfig] = None) -> WindowDataset:

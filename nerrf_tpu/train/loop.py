@@ -299,8 +299,16 @@ def evaluate(eval_fn, params, ds: WindowDataset, batch_size: int = 8,
     dev_data = None
     eval_idx = None
     if resident:
-        dev_data = device_put_chunked(
-            {k: v for k, v in ds.arrays.items() if k in _MODEL_INPUTS})
+        # cache the device copy on the dataset object: periodic mid-training
+        # eval would otherwise repeat a multi-GB chunked upload per call
+        # (r2 advisor finding); invalidate if the arrays dict is replaced
+        cached = getattr(ds, "_resident_cache", None)
+        if cached is not None and cached[0] is ds.arrays:
+            dev_data = cached[1]
+        else:
+            dev_data = device_put_chunked(
+                {k: v for k, v in ds.arrays.items() if k in _MODEL_INPUTS})
+            ds._resident_cache = (ds.arrays, dev_data)
         eval_idx = getattr(eval_fn, "indexed", None)
         if eval_idx is None:  # bare callable: build (uncached) locally
 

@@ -63,6 +63,16 @@ def run_experiment(name_or_path: str, out_dir: str | Path,
             _log(f"experiment {exp.name}: disk corpus {sc.hours:.1f}h, "
                  f"{sc.train_windows} train windows "
                  f"({len(sc.train_shards)} shards)")
+            # shard shapes are authoritative (the manifest's auto-fit); a
+            # config drifting from them misleads every downstream consumer
+            # (bench shapes, capacity bench) — fail loud, not silent
+            cap = sc.manifest.get("graph_capacity")
+            g = exp.dataset.graph
+            if cap and (cap["max_nodes"] != g.max_nodes
+                        or cap["max_edges"] != g.max_edges):
+                _log(f"WARNING: corpus capacities {cap} != experiment config "
+                     f"({g.max_nodes}n/{g.max_edges}e) — training uses the "
+                     f"corpus shapes; update the config/regenerate to align")
             eval_ds = sc.eval_dataset()
             _log(f"eval split: {len(eval_ds)} held-out-trace windows")
             res = train_sharded_stream(
@@ -200,6 +210,9 @@ def main(argv=None) -> int:
     # Multi-host: join the cluster BEFORE any backend use.  Set
     # NERRF_COORDINATOR/NERRF_NUM_PROCESSES/NERRF_PROCESS_ID per process
     # (architecture.mdx:165-189's cross-node deploy, the jax way).
+    from nerrf_tpu.utils import enable_compilation_cache
+
+    enable_compilation_cache()
     from nerrf_tpu.parallel import init_distributed
 
     if init_distributed():

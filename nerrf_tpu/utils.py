@@ -64,3 +64,32 @@ def probe_backend(timeout_sec: float = 120.0,
             return False, (tail[-1][:200] if tail else f"probe rc={rc}"), 0
     except Exception as e:  # spawn/IO failure on *this* host, not the tunnel
         return False, f"probe could not run: {type(e).__name__}: {e}", 0
+
+
+def enable_compilation_cache() -> None:
+    """Point JAX's persistent compilation cache at a per-user directory.
+
+    Every chip-side consumer (train runs, the bench, the offline
+    benchmarks, the device planner) compiles the same handful of programs;
+    over a remote-dispatch link each compile is tens of seconds, and round
+    2 lost most of its chip budget to re-compiles across queue processes.
+    The disk cache makes process N's compile pay forward to process N+1.
+
+    Called explicitly by chip-side entry points — not at package import,
+    which must stay jax-free for CLI startup latency.  Opt out with
+    NERRF_NO_COMPILE_CACHE=1 or by pre-setting JAX_COMPILATION_CACHE_DIR.
+    Only compiles above jax's default time threshold are persisted, so
+    CPU test runs don't spray sub-second entries onto disk."""
+    if os.environ.get("NERRF_NO_COMPILE_CACHE") == "1":
+        return
+    if os.environ.get("JAX_COMPILATION_CACHE_DIR"):
+        return  # operator already chose a location
+    try:
+        import jax
+
+        cache = os.path.join(
+            os.path.expanduser("~"), ".cache", "nerrf_tpu", "xla")
+        os.makedirs(cache, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache)
+    except Exception:
+        pass  # old jax or read-only home: run uncached

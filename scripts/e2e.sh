@@ -9,7 +9,7 @@
 # (.dat/.lockbit paths — same jq filter semantics as test.sh:76-82) arrive
 # end-to-end.
 #
-# Two source modes:
+# Source modes:
 #   ./e2e.sh          — replay the toy trace (CI path: no privileges needed)
 #   ./e2e.sh live     — LIVE kernel capture: the native nerrf-trackerd daemon
 #                       attaches its eBPF program, a scripted "attack"
@@ -17,9 +17,24 @@
 #                       the same ingest path drains real kernel events.
 #                       Skips cleanly (exit 0, "SKIP") without CAP_BPF or
 #                       kernel support — mirrors the daemon's exit codes.
+#   ./e2e.sh obj      — `live`, but the daemon loads the clang-compiled
+#                       bpf/tracepoints.c object (make bpf → NERRF_BPF_OBJ)
+#                       through the ELF loader (src/bpfobj.h) instead of the
+#                       hand-assembled bytecode.  Skips cleanly when clang
+#                       is not installed.  Proves the two program sources
+#                       are interchangeable on the same kernel.
 set -euo pipefail
 
 MODE="${1:-replay}"
+if [ "$MODE" = "obj" ]; then
+    if ! command -v clang >/dev/null 2>&1; then
+        echo "E2E SKIP: obj mode needs clang for make bpf"
+        exit 0
+    fi
+    make -C native bpf >/dev/null
+    export NERRF_BPF_OBJ="$(cd native && pwd)/build/tracepoints.o"
+    MODE=live
+fi
 EVENT_THRESHOLD="${EVENT_THRESHOLD:-10}"
 PORT="${PORT:-50199}"
 WORK="$(mktemp -d)"
@@ -57,6 +72,33 @@ if [ "$MODE" = "live" ]; then
       done ) &
     ATTACK_PID=$!
     trap '[ -n "${SERVER_PID:-}" ] && kill "$SERVER_PID" 2>/dev/null || true; [ -n "${ATTACK_PID:-}" ] && kill "$ATTACK_PID" 2>/dev/null || true; rm -rf "$WORK"' EXIT
+elif [ "$MODE" = "container" ]; then
+    # Run the IMAGE ENTRYPOINT itself (deploy/tracker-entrypoint.sh) against
+    # the checkout — the contract a docker build of deploy/Dockerfile would
+    # execute, minus the image filesystem (no docker in this environment).
+    # The entrypoint probes for live capture and falls back to replay, so
+    # this passes on both privileged and unprivileged hosts.
+    make -C native build/nerrf-trackerd >/dev/null
+    CONTAINER_LIVE=0
+    native/build/nerrf-trackerd --probe >/dev/null 2>&1 && CONTAINER_LIVE=1
+    NERRF_APP_ROOT="$(pwd)" TRACKER_LISTEN_ADDR="127.0.0.1:${PORT}" \
+        TRACKER_MAX_SECONDS=90 sh deploy/tracker-entrypoint.sh \
+        2> "$WORK/entrypoint.log" &
+    SERVER_PID=$!
+    if [ "$CONTAINER_LIVE" = 1 ]; then
+        ( V="$WORK/victim"; mkdir -p "$V"
+          for round in $(seq 1 120); do
+              for i in 1 2 3; do
+                  printf 'confidential payload %s.%s' "$round" "$i" \
+                      > "$V/doc_${round}_$i.dat"
+                  mv "$V/doc_${round}_$i.dat" "$V/doc_${round}_$i.dat.lockbit3"
+                  rm "$V/doc_${round}_$i.dat.lockbit3"
+              done
+              sleep 0.5
+          done ) &
+        ATTACK_PID=$!
+        trap '[ -n "${SERVER_PID:-}" ] && kill "$SERVER_PID" 2>/dev/null || true; [ -n "${ATTACK_PID:-}" ] && kill "$ATTACK_PID" 2>/dev/null || true; rm -rf "$WORK"' EXIT
+    fi
 else
     python -m nerrf_tpu.cli serve \
         --trace datasets/traces/toy_trace.csv \
@@ -85,6 +127,7 @@ fi
 # threshold over the noise floor (realistic capture conditions, not a filter)
 INGEST_ARGS=()
 [ "$MODE" = "live" ] && INGEST_ARGS+=(--max-events 500 --timeout 45)
+[ "${CONTAINER_LIVE:-0}" = 1 ] && INGEST_ARGS+=(--max-events 500 --timeout 45)
 python -m nerrf_tpu.cli ingest \
     --target "$TARGET" --store-dir "$WORK/store" \
     --metrics-port -1 --timeout 30 "${INGEST_ARGS[@]+"${INGEST_ARGS[@]}"}" \

@@ -1,0 +1,240 @@
+#!/usr/bin/env python3
+"""Render the Helm chart without helm — a `helm template` golden path.
+
+The r2 verdict's deploy finding: the chart had only ever been *parsed as
+text*, never rendered, so a template bug (bad pipe, missing value, broken
+nindent) would surface at `helm install` on a customer cluster.  No helm
+binary exists in this environment, so this implements the Go-template
+subset the chart actually uses — `{{ .Values.x }}` dotted lookups,
+`{{- if }}…{{- end }}`, `{{ include "name" . }}` against `_helpers.tpl`
+defines, and the `quote`/`nindent`/`toYaml` pipe functions — and renders
+every template against values.yaml into real YAML.
+
+    python scripts/render_chart.py [--chart deploy/charts/nerrf] [--out DIR]
+    python scripts/render_chart.py --set tracker.live=false
+
+tests/test_deploy.py renders through this and schema-checks the documents;
+on a machine with real helm, `helm template` must agree (the subset is
+semantics-compatible for these templates).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+
+def _load_yaml(path: Path):
+    import yaml
+
+    return yaml.safe_load(path.read_text())
+
+
+def _lookup(ctx: dict, dotted: str):
+    """Resolve `.Values.tracker.port`-style paths against the context."""
+    cur = ctx
+    for part in dotted.lstrip(".").split("."):
+        if not part:
+            continue
+        if isinstance(cur, dict) and part in cur:
+            cur = cur[part]
+        else:
+            raise KeyError(f"no value at {dotted!r} (missing {part!r})")
+    return cur
+
+
+def _to_yaml(val, indent=0) -> str:
+    import yaml
+
+    return yaml.safe_dump(val, default_flow_style=False).rstrip("\n")
+
+
+def _apply_pipe(value, pipe: str, ctx: dict):
+    pipe = pipe.strip()
+    if pipe == "quote":
+        return json.dumps(str(value))
+    if pipe == "toYaml":
+        return _to_yaml(value)
+    m = re.fullmatch(r"nindent\s+(\d+)", pipe)
+    if m:
+        n = int(m.group(1))
+        pad = " " * n
+        text = str(value)
+        return "\n" + "\n".join(pad + line if line else line
+                                for line in text.splitlines())
+    m = re.fullmatch(r"indent\s+(\d+)", pipe)
+    if m:
+        pad = " " * int(m.group(1))
+        return "\n".join(pad + line if line else line
+                         for line in str(value).splitlines())
+    if pipe == "default":
+        return value
+    raise ValueError(f"unsupported pipe function {pipe!r}")
+
+
+class Renderer:
+    """The Go-template subset: actions, if/end blocks, includes, pipes."""
+
+    def __init__(self, ctx: dict, defines: dict[str, str]):
+        self.ctx = ctx
+        self.defines = defines
+
+    def _eval_expr(self, expr: str):
+        expr = expr.strip()
+        parts = [p.strip() for p in expr.split("|")]
+        head = parts[0]
+        m = re.fullmatch(r'include\s+"([^"]+)"\s+\.', head)
+        if m:
+            name = m.group(1)
+            if name not in self.defines:
+                raise KeyError(f"include of undefined template {name!r}")
+            value = self.render(self.defines[name]).strip("\n")
+        elif head.startswith("."):
+            value = _lookup(self.ctx, head)
+        elif re.fullmatch(r'"[^"]*"', head):
+            value = head[1:-1]
+        elif re.fullmatch(r"toYaml\s+\.[\w.]+", head):
+            value = _to_yaml(_lookup(self.ctx, head.split(None, 1)[1]))
+        elif re.fullmatch(r"quote\s+\.[\w.]+", head):
+            value = json.dumps(str(_lookup(self.ctx, head.split(None, 1)[1])))
+        else:
+            raise ValueError(f"unsupported expression {head!r}")
+        for pipe in parts[1:]:
+            value = _apply_pipe(value, pipe, self.ctx)
+        return value
+
+    def render(self, text: str) -> str:
+        # tokenize: {{- … -}} trim markers eat adjacent whitespace incl. the
+        # newline, like Go templates
+        token = re.compile(r"\{\{(-?)\s*(.*?)\s*(-?)\}\}", re.S)
+        out: list[str] = []
+        stack: list[bool] = []   # emitting state per open `if`
+        pos = 0
+
+        def emitting() -> bool:
+            return all(stack)
+
+        for m in token.finditer(text):
+            lead = text[pos:m.start()]
+            if m.group(1) == "-":
+                lead = lead.rstrip(" \t\n")
+            if emitting():
+                out.append(lead)
+            pos = m.end()
+            if m.group(3) == "-":
+                rest = text[pos:]
+                stripped = rest.lstrip(" \t")
+                if stripped.startswith("\n"):
+                    stripped = stripped[1:]
+                pos = len(text) - len(stripped)
+            action = m.group(2).strip()
+            if action.startswith("if "):
+                cond = False
+                if emitting():
+                    try:
+                        cond = bool(self._eval_expr(action[3:]))
+                    except KeyError:
+                        cond = False
+                stack.append(cond)
+            elif action == "else":
+                if not stack:
+                    raise ValueError("{{ else }} outside {{ if }}")
+                prev = stack.pop()
+                # the else arm emits iff the if arm did not (and outer scope
+                # is emitting)
+                stack.append((not prev) and all(stack))
+            elif action == "end":
+                if not stack:
+                    raise ValueError("unbalanced {{ end }}")
+                stack.pop()
+            elif action.startswith("define") or action == "-":
+                pass  # handled at load time
+            else:
+                if emitting():
+                    out.append(str(self._eval_expr(action)))
+        if stack:
+            raise ValueError("unclosed {{ if }} block")
+        out.append(text[pos:])
+        return "".join(out)
+
+
+def load_defines(helpers_text: str) -> dict[str, str]:
+    defines: dict[str, str] = {}
+    for m in re.finditer(
+            r'\{\{-?\s*define\s+"([^"]+)"\s*-?\}\}(.*?)\{\{-?\s*end\s*-?\}\}',
+            helpers_text, re.S):
+        body = m.group(2)
+        defines[m.group(1)] = body.strip("\n")
+    return defines
+
+
+def render_chart(chart_dir: Path, overrides: list[str] = (),
+                 release: str = "nerrf", namespace: str = "nerrf") -> dict:
+    chart_meta = _load_yaml(chart_dir / "Chart.yaml")
+    values = _load_yaml(chart_dir / "values.yaml")
+    for ov in overrides:
+        key, _, raw = ov.partition("=")
+        val = {"true": True, "false": False}.get(
+            raw, int(raw) if raw.isdigit() else raw)
+        cur = values
+        parts = key.split(".")
+        for p in parts[:-1]:
+            cur = cur.setdefault(p, {})
+        cur[parts[-1]] = val
+
+    ctx = {
+        "Values": values,
+        "Chart": {"Name": chart_meta.get("name"),
+                  "AppVersion": str(chart_meta.get("appVersion", "")),
+                  "Version": str(chart_meta.get("version", ""))},
+        "Release": {"Name": release, "Namespace": namespace,
+                    "Service": "Helm"},
+    }
+    tmpl_dir = chart_dir / "templates"
+    defines: dict[str, str] = {}
+    for tpl in sorted(tmpl_dir.glob("*.tpl")):
+        defines.update(load_defines(tpl.read_text()))
+    r = Renderer(ctx, defines)
+    rendered: dict[str, str] = {}
+    for tpl in sorted(tmpl_dir.glob("*.yaml")):
+        rendered[tpl.name] = r.render(tpl.read_text())
+    return rendered
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--chart", default="deploy/charts/nerrf")
+    ap.add_argument("--out", default=None,
+                    help="write rendered YAML files here (default: stdout)")
+    ap.add_argument("--set", action="append", default=[], dest="sets",
+                    metavar="key.path=value")
+    ap.add_argument("--release", default="nerrf")
+    ap.add_argument("--namespace", default="nerrf")
+    args = ap.parse_args(argv)
+
+    import yaml
+
+    rendered = render_chart(Path(args.chart), args.sets, args.release,
+                            args.namespace)
+    n_docs = 0
+    for name, text in rendered.items():
+        docs = [d for d in yaml.safe_load_all(text) if d]
+        n_docs += len(docs)
+        if args.out:
+            outdir = Path(args.out)
+            outdir.mkdir(parents=True, exist_ok=True)
+            (outdir / name).write_text(text)
+        else:
+            print(f"---\n# Source: {name}\n{text.strip()}")
+    print(f"# rendered {len(rendered)} templates, {n_docs} documents OK",
+          file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,54 +1,80 @@
 #!/bin/bash
-# Round-2 chip-work queue: waits for the TPU tunnel, then runs the offline
-# artifact producers serially (100h training, adversarial eval, graph
-# capacity crossover, planner throughput probe, bench.py smoke →
-# /tmp/bench_smoke.json).  Safe to re-run; each step is idempotent or
-# overwrite-only.  Logs: /tmp/tpu_queue.log + per-step logs.
+# Round-3 chip-work queue: waits for the TPU tunnel, then runs the offline
+# artifact producers serially.  Order matters — training first (its
+# checkpoint feeds the adversarial eval), then the evals, then the
+# benchmark of record last so it exercises warm compilation caches.
+#
+#   1. joint-100h training on the zero-drop corpus  → joint100h_r3.json
+#   2. adversarial eval vs that checkpoint          → adversarial_r3.json
+#   3. graph capacity + Pallas crossover            → graph_capacity.json
+#   4. planner throughput probe                     → mcts_tpu.log
+#   5. recovery benches (device planner)            → m{0,1}_recovery.json
+#   6. bench.py smoke                               → /tmp/bench_smoke.json
+#
+# Safe to re-run; each step is idempotent or overwrite-only.  Nothing here
+# git-commits — artifacts are reviewed and committed by hand.
+# Logs: /tmp/tpu_queue.log + per-step logs in /tmp.
 cd "$(dirname "$0")/.."
 log() { echo "[queue $(date +%H:%M:%S)] $*" >> /tmp/tpu_queue.log; }
-log "watcher started"
+log "watcher started (r3)"
 while true; do
   if timeout 90 python -c "import jax; assert jax.default_backend()=='tpu'" 2>/dev/null; then
     log "TPU is back"; break
   fi
   sleep 120
 done
-while [ ! -f datasets/corpus100/manifest.json ]; do
-  log "waiting for corpus100 generation"; sleep 60
+# require the REGENERATED corpus (auto-fit capacities + zero-drop proof in
+# the manifest) — training on the r2 truncated corpus would repeat weak #3
+while ! python - <<'EOF' 2>/dev/null
+import json, sys
+m = json.load(open("datasets/corpus100/manifest.json"))
+sys.exit(0 if m.get("complete") and m.get("auto_fit")
+         and m.get("dropped", {}).get("windows", 1) == 0 else 1)
+EOF
+do
+  log "waiting for zero-drop corpus100"; sleep 60
 done
-log "1/5 joint-100h training"
-# both prior tunnel wedges struck during this step's shard upload (now
-# chunked); resume-from-checkpoint makes one retry cheap
+log "1/6 joint-100h training"
+# the corpus is ~10 GB and rotates shards through the chip each epoch; over
+# a ~0.5 GB/s tunnel the wall clock is transfer-bound, so budget generously
+# and rely on resume-from-checkpoint for the retry
 for attempt in 1 2; do
-  timeout 3600 python -m nerrf_tpu.train.run --experiment joint-100h \
-    --out runs/joint-100h-r2 --ckpt-every 2000 > /tmp/joint100.log 2>&1
+  timeout 7200 python -m nerrf_tpu.train.run --experiment joint-100h \
+    --out runs/joint-100h-r3 --ckpt-every 2000 > /tmp/joint100.log 2>&1
   rc=$?
   log "joint-100h attempt $attempt rc=$rc"
   [ $rc -eq 0 ] && break
 done
-if [ -f runs/joint-100h-r2/metrics.json ]; then
+if [ -f runs/joint-100h-r3/metrics.json ]; then
   mkdir -p benchmarks/results
-  cp runs/joint-100h-r2/metrics.json benchmarks/results/joint100h_r2.json
+  cp runs/joint-100h-r3/metrics.json benchmarks/results/joint100h_r3.json
   log "copied joint100h artifact"
 fi
-log "2/5 adversarial eval"
-if [ -f runs/joint-100h-r2/model/model_config.json ]; then
-  timeout 2400 python benchmarks/run_adversarial_eval.py \
-    --out benchmarks/results/adversarial_r2.json \
-    --model-dir runs/joint-100h-r2/model > /tmp/adv5.log 2>&1
+log "2/6 adversarial eval"
+if [ -f runs/joint-100h-r3/model/model_config.json ]; then
+  timeout 3600 python benchmarks/run_adversarial_eval.py \
+    --out benchmarks/results/adversarial_r3.json \
+    --model-dir runs/joint-100h-r3/model > /tmp/adv5.log 2>&1
 else
-  timeout 2400 python benchmarks/run_adversarial_eval.py \
-    --out benchmarks/results/adversarial_r2.json > /tmp/adv5.log 2>&1
+  timeout 3600 python benchmarks/run_adversarial_eval.py \
+    --out benchmarks/results/adversarial_r3.json > /tmp/adv5.log 2>&1
 fi
 log "adversarial rc=$?"
-log "3/5 graph capacity (pallas crossover)"
-timeout 1200 python benchmarks/run_graph_capacity.py \
+log "3/6 graph capacity (pallas crossover)"
+timeout 1800 python benchmarks/run_graph_capacity.py \
   --out benchmarks/results/graph_capacity.json > /tmp/graphcap.log 2>&1
 log "graphcap rc=$?"
-log "4/5 planner throughput probe"
+log "4/6 planner throughput probe"
 timeout 1200 python benchmarks/run_planner_probe.py > /tmp/mcts_tpu.log 2>&1
 log "mcts rc=$?"
-log "5/5 bench.py smoke (validates the driver's benchmark of record)"
-timeout 2400 python bench.py > /tmp/bench_smoke.json 2> /tmp/bench_smoke.log
+log "5/6 recovery benches (device planner in the KPI path)"
+timeout 1800 python benchmarks/run_recovery_bench.py --scale m0 \
+  --out benchmarks/results/m0_recovery.json > /tmp/recovery_m0.log 2>&1
+log "m0 recovery rc=$?"
+timeout 1800 python benchmarks/run_recovery_bench.py --scale m1 \
+  --out benchmarks/results/m1_recovery.json > /tmp/recovery_m1.log 2>&1
+log "m1 recovery rc=$?"
+log "6/6 bench.py smoke (validates the driver's benchmark of record)"
+timeout 3600 python bench.py > /tmp/bench_smoke.json 2> /tmp/bench_smoke.log
 log "bench rc=$?"
 log "queue done"

@@ -81,3 +81,69 @@ def test_e2e_script_passes(repo_root):
     )
     assert out.returncode == 0, out.stdout + out.stderr
     assert "E2E PASS" in out.stdout
+
+
+# ---- rendered-chart golden tests (scripts/render_chart.py) -----------------
+# The r2 gap: the chart was only ever parsed as text; these render it (the
+# helm-template subset renderer) and schema-check the resulting documents,
+# in both value configurations that change the template structure.
+
+
+def _render(repo_root, *sets):
+    sys.path.insert(0, str(repo_root / "scripts"))
+    try:
+        from render_chart import render_chart
+    finally:
+        sys.path.pop(0)
+    return render_chart(repo_root / "deploy" / "charts" / "nerrf",
+                        list(sets))
+
+
+def test_chart_renders_default_values(repo_root):
+    rendered = _render(repo_root)
+    assert set(rendered) == {"tracker-daemonset.yaml",
+                             "ingest-deployment.yaml"}
+    docs = []
+    for name, text in rendered.items():
+        assert "{{" not in text, f"unrendered action left in {name}"
+        docs += [d for d in yaml.safe_load_all(text) if d]
+    by_kind = {d["kind"]: d for d in docs}
+    assert {"DaemonSet", "Deployment", "Service"} <= set(by_kind)
+
+    ds = by_kind["DaemonSet"]
+    tracker = ds["spec"]["template"]["spec"]["containers"][0]
+    assert tracker["image"] == "nerrf/nerrf-tpu:latest"
+    # live mode: entrypoint script, not args
+    assert tracker["command"][-1].endswith("tracker-entrypoint.sh")
+    assert {p["containerPort"] for p in tracker["ports"]} == {50051, 9090}
+    assert ds["spec"]["template"]["spec"]["hostPID"] is True
+    mounts = {m["mountPath"] for m in tracker["volumeMounts"]}
+    assert "/sys/kernel/tracing" in mounts
+
+    dep = by_kind["Deployment"]
+    ingest = dep["spec"]["template"]["spec"]["containers"][0]
+    assert any(a.startswith("--target=nerrf-tracker.nerrf.svc:50051")
+               for a in ingest["args"])
+    # annotations from metrics.scrapeAnnotations
+    ann = ds["spec"]["template"]["metadata"]["annotations"]
+    assert ann["prometheus.io/port"] == "9090"
+
+
+def test_chart_renders_replay_variant(repo_root):
+    rendered = _render(repo_root, "tracker.live=false",
+                       "metrics.scrapeAnnotations=false")
+    ds = next(d for d in yaml.safe_load_all(
+        rendered["tracker-daemonset.yaml"]) if d and d["kind"] == "DaemonSet")
+    tracker = ds["spec"]["template"]["spec"]["containers"][0]
+    # replay mode: serve args instead of the entrypoint command
+    assert "command" not in tracker
+    assert tracker["args"][0] == "serve"
+    assert "annotations" not in ds["spec"]["template"]["metadata"]
+
+
+def test_chart_disabled_components_render_empty(repo_root):
+    rendered = _render(repo_root, "tracker.enabled=false",
+                       "ingest.enabled=false")
+    for name, text in rendered.items():
+        assert not [d for d in yaml.safe_load_all(text) if d], (
+            f"{name} should render empty when disabled")

@@ -197,3 +197,30 @@ def test_device_mcts_respects_wall_clock_budget():
     plan = dev.plan()
     # budget of zero: exactly one compiled chunk runs, then the check trips
     assert plan.rollouts <= 128
+
+
+def test_device_mcts_program_reuse_across_incidents():
+    """Two incidents in the same shape bucket — different scores, different
+    file counts, freshly fitted value nets — must share ONE compiled search
+    executable (r2 verdict: plan time dominated MTTR because every incident
+    recompiled).  Identity of the jitted entry points is the contract."""
+    from nerrf_tpu.planner import DeviceMCTS
+    from nerrf_tpu.planner.value_net import ValueNet
+
+    d1, d2 = _domain(seed=11), _domain(seed=12)
+    n1, n2 = ValueNet.create(hidden=32), ValueNet.create(hidden=32)
+    assert n1.apply_fn is n2.apply_fn  # shared per-architecture apply
+    a = DeviceMCTS(d1, cfg=MCTSConfig(num_simulations=50),
+                   value_apply=n1.apply_fn, value_params=n1.params)
+    b = DeviceMCTS(d2, cfg=MCTSConfig(num_simulations=50),
+                   value_apply=n2.apply_fn, value_params=n2.params)
+    assert a._search_chunk is b._search_chunk
+    assert a._init_tree is b._init_tree
+    # warmed via a dummy domain, a real incident still reuses the program
+    warm = DeviceMCTS.warmup_for(d1.F, d1.P, cfg=MCTSConfig(num_simulations=50),
+                                 value_apply=n1.apply_fn, value_params=n1.params,
+                                 max_steps=d1.max_steps)
+    assert warm._search_chunk is a._search_chunk
+    # and the searches still plan correctly against their own ctx
+    plan = a.plan()
+    assert plan.rollouts == 50
