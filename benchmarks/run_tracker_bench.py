@@ -1,0 +1,236 @@
+#!/usr/bin/env python3
+"""Tracker daemon performance: sustained events/s, delivery latency, CPU.
+
+The reference's headline tracker numbers are docs-claims, not artifacts
+(`/root/reference/docs/content/docs/tracker/overview.mdx:186-196`: peak
+1,250 evt/s, sustained 1,100 evt/s over 10 min, P99 240 µs, 3.8% CPU on a
+4-core VM; saturation ~8k evt/s at 100% CPU per `implementation.mdx:556`).
+This harness produces the equivalent numbers for OUR daemon
+(`native/build/nerrf-trackerd`: hand-assembled eBPF → mmap ring → HTTP/2
+gRPC) as a checked-in artifact, measured end-to-end:
+
+  loadgen process (tight write/rename/unlink loop on tmpfs)
+    → kernel tracepoint → ring buffer → daemon → gRPC EventBatch frames
+    → TrackerClient (native decode) where each event's delivery latency is
+      (client wall clock at frame decode) − (event's kernel timestamp,
+      already monotonic→wall corrected by the daemon).
+
+CPU overhead is the daemon's utime+stime delta over the measurement window
+against wall clock (one core = 100).  Kernel-side drops (ring full) are
+read from the daemon's stderr stats and reported — drops are observable
+loss, never silent.
+
+Skips cleanly (exit 0, "SKIP") without BPF permissions, like the e2e.
+
+Usage: python benchmarks/run_tracker_bench.py [--seconds 30]
+           [--out benchmarks/results/tracker_perf.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+import numpy as np
+
+REPO = Path(__file__).resolve().parents[1]
+DAEMON = REPO / "native" / "build" / "nerrf-trackerd"
+
+
+def _log(m):
+    print(f"[tracker-bench] {m}", file=sys.stderr, flush=True)
+
+
+def _proc_cpu_seconds(pid: int) -> float:
+    parts = Path(f"/proc/{pid}/stat").read_text().rsplit(") ", 1)[1].split()
+    hz = os.sysconf("SC_CLK_TCK")
+    return (int(parts[11]) + int(parts[12])) / hz  # utime + stime
+
+
+_LOADGEN = r"""
+import os, sys, time
+d = sys.argv[1]
+deadline = time.time() + float(sys.argv[2])
+rate = float(sys.argv[3])  # tracked syscalls/sec; 0 = unthrottled flood
+i = 0
+t0 = time.time()
+while time.time() < deadline:
+    p = os.path.join(d, f"f_{i % 64}.dat")
+    with open(p, "w") as f:
+        f.write("confidential-payload-" + str(i))
+    os.rename(p, p + ".lockbit3")
+    os.unlink(p + ".lockbit3")
+    i += 1
+    if rate > 0:
+        # ~4 tracked events per round (openat+write+rename+unlink)
+        target_t = t0 + (i * 4) / rate
+        lag = target_t - time.time()
+        if lag > 0:
+            time.sleep(lag)
+print(i * 4)
+"""
+
+
+def _measure(seconds: float, rate: float) -> dict:
+    """One leg: fresh daemon + paced loadgen → delivered-rate/latency/CPU."""
+    work = Path(tempfile.mkdtemp(prefix="nerrf-trkbench-",
+                                 dir="/dev/shm" if os.path.isdir("/dev/shm")
+                                 else None))
+    sock = work / "tracker.sock"
+    daemon = subprocess.Popen(
+        [str(DAEMON), "--listen", f"unix:{sock}",
+         "--max-seconds", str(int(seconds) + 30)],
+        stderr=subprocess.PIPE, text=True)
+    try:
+        for _ in range(40):
+            if sock.exists():
+                break
+            time.sleep(0.25)
+        else:
+            raise RuntimeError("daemon socket never appeared")
+
+        victim = work / "victim"
+        victim.mkdir()
+        loadgen = subprocess.Popen(
+            [sys.executable, "-c", _LOADGEN, str(victim),
+             str(seconds + 5), str(rate)],
+            stdout=subprocess.PIPE, text=True)
+
+        from nerrf_tpu.ingest.service import TrackerClient
+
+        lat_us: list = []
+        count = 0
+        per_sec: dict = {}
+        cpu0 = _proc_cpu_seconds(daemon.pid)
+        t0 = time.time()
+        client = TrackerClient(f"unix:{sock}")
+        try:
+            for block, _ in client.iter_blocks(timeout=seconds + 20):
+                now_ns = time.time_ns()
+                if time.time() - t0 > seconds:
+                    break
+                ts = block.ts_ns[block.valid]
+                count += len(ts)
+                # delivery latency per event in this frame
+                lat_us.append((now_ns - ts).astype(np.float64) / 1e3)
+                for s in np.unique(ts // 1_000_000_000):
+                    per_sec[int(s)] = per_sec.get(int(s), 0) + int(
+                        (ts // 1_000_000_000 == s).sum())
+        except Exception as e:
+            _log(f"stream ended: {e!r}")
+        elapsed = time.time() - t0
+        cpu1 = _proc_cpu_seconds(daemon.pid)
+        loadgen.send_signal(signal.SIGTERM)
+
+        daemon.terminate()
+        try:
+            _, stderr = daemon.communicate(timeout=10)
+        except subprocess.TimeoutExpired:
+            daemon.kill()
+            _, stderr = daemon.communicate()
+        m = re.findall(r"kernel_dropped=(\d+)", stderr or "")
+        kernel_dropped = int(m[-1]) if m else None
+
+        lat = np.concatenate(lat_us) if lat_us else np.zeros(0)
+        # trim partial edge seconds (warmup + shutdown skew)
+        full_secs = sorted(per_sec)[1:-1]
+        sustained = (np.mean([per_sec[s] for s in full_secs])
+                     if full_secs else count / max(elapsed, 1e-9))
+        return {
+            "offered_rate": "unthrottled" if rate == 0 else rate,
+            "seconds_measured": round(elapsed, 1),
+            "events_delivered": count,
+            "events_per_sec_sustained": round(float(sustained), 1),
+            "events_per_sec_peak_1s": (max(per_sec.values())
+                                       if per_sec else 0),
+            "delivery_latency_us": {
+                "p50": round(float(np.percentile(lat, 50)), 1) if len(lat) else None,
+                "p99": round(float(np.percentile(lat, 99)), 1) if len(lat) else None,
+                "max": round(float(lat.max()), 1) if len(lat) else None,
+            },
+            "daemon_cpu_pct_of_one_core": round(
+                100.0 * (cpu1 - cpu0) / max(elapsed, 1e-9), 2),
+            "kernel_dropped": kernel_dropped,
+        }
+    finally:
+        if daemon.poll() is None:
+            daemon.kill()
+        subprocess.run(["rm", "-rf", str(work)])
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--seconds", type=float, default=30.0)
+    ap.add_argument("--rate", type=float, default=2000.0,
+                    help="paced-leg offered load (tracked events/s) — ~2x "
+                         "the reference's sustained claim")
+    ap.add_argument("--out", default="benchmarks/results/tracker_perf.json")
+    args = ap.parse_args(argv)
+
+    if not DAEMON.exists():
+        r = subprocess.run(["make", "-C", str(REPO / "native"),
+                            "build/nerrf-trackerd"], capture_output=True)
+        if r.returncode != 0:
+            _log("SKIP: daemon build failed")
+            return 0
+    probe = subprocess.run([str(DAEMON), "--probe"], capture_output=True,
+                           text=True)
+    if probe.returncode != 0:
+        _log(f"SKIP: live capture unavailable (probe rc={probe.returncode})")
+        return 0
+
+    # Leg 1 — paced at the reference-comparable load: the latency/CPU KPIs.
+    # Latency is only meaningful below saturation; a flooded single core
+    # measures queue depth, not the pipeline.
+    _log(f"paced leg: {args.rate:.0f} evt/s for {args.seconds:.0f}s")
+    paced = _measure(args.seconds, args.rate)
+    _log(f"  {paced['events_per_sec_sustained']:.0f} evt/s sustained, "
+         f"p99 {paced['delivery_latency_us']['p99']}us, "
+         f"cpu {paced['daemon_cpu_pct_of_one_core']}%")
+
+    # Leg 2 — unthrottled flood: peak delivered throughput (drops expected
+    # once the 256 KiB ring outruns the consumer; they are counted).
+    _log(f"flood leg: unthrottled for {args.seconds:.0f}s")
+    flood = _measure(args.seconds, 0.0)
+    _log(f"  {flood['events_per_sec_sustained']:.0f} evt/s sustained, "
+         f"peak 1s {flood['events_per_sec_peak_1s']}, "
+         f"kernel_dropped {flood['kernel_dropped']}")
+
+    result = {
+        "transport": "unix-socket gRPC, EventBatch=64, native decode",
+        "host": f"{os.cpu_count()} cpu core(s) "
+                "(loadgen + daemon + client share them)",
+        "paced": paced,
+        "flood": flood,
+        "reference_docs_claims": {
+            "note": "docs-claimed, no checked-in artifact "
+                    "(tracker/overview.mdx:186-196; 4-core VM)",
+            "events_per_sec_peak": 1250,
+            "events_per_sec_sustained": 1100,
+            "p99_latency_us": 240,
+            "cpu_overhead_pct": 3.8,
+            "saturation_events_per_sec": 8000,
+        },
+    }
+    out = Path(args.out)
+    out.parent.mkdir(parents=True, exist_ok=True)
+    out.write_text(json.dumps(result, indent=2) + "\n")
+    print(json.dumps({"paced": paced, "flood_peak_1s":
+                      flood["events_per_sec_peak_1s"],
+                      "flood_sustained":
+                      flood["events_per_sec_sustained"]}))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -123,10 +123,18 @@ inline std::vector<bpfobj_detail::Insn> bpfobj_extract(
     set_err(errbuf, errlen, "bad shstrndx");
     return out;
   }
+  // string lookups verify a NUL exists before data+len: this is a raw-
+  // buffer API (callers may mmap), so a string table whose last name runs
+  // to the final byte must not send strcmp past the mapping
+  auto bounded_str = [&](uint64_t base, uint64_t off) -> const char * {
+    if (base >= len || off >= len - base) return "";
+    const char *s = reinterpret_cast<const char *>(data + base + off);
+    if (!memchr(s, 0, len - base - off)) return "";
+    return s;
+  };
   const Shdr &strs = sh[eh.shstrndx];
   auto sec_name = [&](uint32_t off) -> const char * {
-    if (strs.offset >= len || off >= len - strs.offset) return "";
-    return reinterpret_cast<const char *>(data + strs.offset + off);
+    return bounded_str(strs.offset, off);
   };
 
   int prog_idx = -1, symtab_idx = -1;
@@ -169,7 +177,9 @@ inline std::vector<bpfobj_detail::Insn> bpfobj_extract(
     if (idx >= syms.size() || !symstr) return "";
     uint32_t off = syms[idx].name;
     if (off >= symstr_len) return "";
-    return symstr + off;
+    return bounded_str(
+        static_cast<uint64_t>(symstr - reinterpret_cast<const char *>(data)),
+        off);
   };
 
   // apply REL/RELA sections that target the program section

@@ -386,10 +386,24 @@ extern "C" nerrf_capture *nerrf_capture_open(uint32_t ringbuf_bytes,
 
   {
     // Program source ladder: a clang-compiled object (NERRF_BPF_OBJ, or
-    // build/tracepoints.o next to the binary) when present — portable
-    // clang codegen, same semantics — else the hand-assembled bytecode.
+    // tracepoints.o next to this binary — where `make bpf` drops it) when
+    // present — portable clang codegen, same semantics — else the
+    // hand-assembled bytecode.
     std::vector<nerrf::BpfInsn> insns;
     const char *obj = getenv("NERRF_BPF_OBJ");
+    char adj[4096] = {0};
+    if (!(obj && obj[0])) {
+      ssize_t n = readlink("/proc/self/exe", adj, sizeof(adj) - 32);
+      if (n > 0) {
+        adj[n] = 0;
+        char *slash = strrchr(adj, '/');
+        if (slash) {
+          snprintf(slash + 1, sizeof(adj) - (slash + 1 - adj),
+                   "tracepoints.o");
+          if (access(adj, R_OK) == 0) obj = adj;
+        }
+      }
+    }
     if (obj && obj[0]) {
       char oerr[256] = {0};
       auto oi = nerrf::bpfobj_extract_file(

@@ -63,6 +63,9 @@ def cmd_simulate(args) -> int:
 
 # --------------------------------------------------------------------------
 def cmd_train_detector(args) -> int:
+    from nerrf_tpu.utils import enable_compilation_cache
+
+    enable_compilation_cache()
     from nerrf_tpu.data import make_corpus
     from nerrf_tpu.graph import GraphConfig
     from nerrf_tpu.models import GraphSAGEConfig, JointConfig, LSTMConfig
@@ -106,6 +109,11 @@ def cmd_train_detector(args) -> int:
 
 # --------------------------------------------------------------------------
 def cmd_undo(args) -> int:
+    # undo is the MTTR-critical path and compiles detector + planner
+    # programs — the persistent cache makes restart N+1's compiles free
+    from nerrf_tpu.utils import enable_compilation_cache
+
+    enable_compilation_cache()
     from nerrf_tpu.data.loaders import load_trace_jsonl
     from nerrf_tpu.pipeline import build_undo_domain, heuristic_detect, model_detect
     from nerrf_tpu.planner import MCTSConfig, make_planner
