@@ -104,12 +104,16 @@ def _benign_touched_files(trace) -> set:
     return out
 
 
-def _file_metrics(traces, detect) -> dict:
+def _file_metrics(items, detect) -> dict:
+    """items: (trace, payload) pairs; ``detect(item)`` → DetectionResult.
+    Payload carries a precomputed detection so aggregation variants don't
+    re-run the model."""
     tp = fp = 0
     attacked_total = 0
     flagged_total = 0
-    for tr in traces:
-        det = detect(tr)
+    for item in items:
+        tr = item[0]
+        det = detect(item)
         flagged = set(det.flagged_files(0.5))
         encrypted, touched = _attacked_files(tr)
         attacked_total += len(encrypted)
@@ -191,17 +195,28 @@ def main(argv=None) -> int:
             m = evaluate(eval_fn, params, ds)
             entry["edge_auc"] = round(m["edge_auc"], 4)
             entry["seq_f1"] = round(m["seq_f1"], 4)
+        # one model pass per trace; both aggregation rules derived from the
+        # cached per-window scores (pipeline.DetectionResult.rescored)
+        detections = [model_detect(tr, params, model) for tr in traces]
         entry["model"] = _file_metrics(
-            traces, lambda tr: model_detect(tr, params, model))
-        entry["heuristic"] = _file_metrics(traces, heuristic_detect)
+            list(zip(traces, detections)), lambda td: td[1])
+        entry["model_robust"] = _file_metrics(
+            list(zip(traces, detections)), lambda td: td[1].rescored("robust"))
+        entry["heuristic"] = _file_metrics(
+            [(tr, None) for tr in traces], lambda td: heuristic_detect(td[0]))
         report["scenarios"][scenario] = entry
         worst_fp = max(worst_fp, entry["model"]["fp_undo_rate"])
         _log(f"  {scenario}: {json.dumps(entry)}")
 
+    worst_fp_robust = max(
+        e["model_robust"]["fp_undo_rate"]
+        for e in report["scenarios"].values())
     report["kpi"] = {
         "fp_undo_rate_worst_model": round(worst_fp, 4),
+        "fp_undo_rate_worst_model_robust": round(worst_fp_robust, 4),
         "fp_undo_kpi": 0.05,
         "fp_undo_met": bool(worst_fp < 0.05),
+        "fp_undo_met_robust": bool(worst_fp_robust < 0.05),
     }
     report["wall_seconds"] = round(time.time() - t0, 1)
     out = Path(args.out)

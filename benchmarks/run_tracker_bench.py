@@ -58,13 +58,15 @@ def _proc_cpu_seconds(pid: int) -> float:
 
 
 _LOADGEN = r"""
-import os, sys, time
+import os, signal, sys, time
 d = sys.argv[1]
 deadline = time.time() + float(sys.argv[2])
 rate = float(sys.argv[3])  # tracked syscalls/sec; 0 = unthrottled flood
+stop = []
+signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
 i = 0
 t0 = time.time()
-while time.time() < deadline:
+while time.time() < deadline and not stop:
     p = os.path.join(d, f"f_{i % 64}.dat")
     with open(p, "w") as f:
         f.write("confidential-payload-" + str(i))
@@ -110,7 +112,7 @@ def _measure(seconds: float, rate: float) -> dict:
 
         lat_us: list = []
         count = 0
-        per_sec: dict = {}
+        per_sec: dict = {}  # DELIVERED events per wall-clock receipt second
         cpu0 = _proc_cpu_seconds(daemon.pid)
         t0 = time.time()
         client = TrackerClient(f"unix:{sock}")
@@ -123,14 +125,23 @@ def _measure(seconds: float, rate: float) -> dict:
                 count += len(ts)
                 # delivery latency per event in this frame
                 lat_us.append((now_ns - ts).astype(np.float64) / 1e3)
-                for s in np.unique(ts // 1_000_000_000):
-                    per_sec[int(s)] = per_sec.get(int(s), 0) + int(
-                        (ts // 1_000_000_000 == s).sum())
+                # bucket by RECEIPT time: kernel-timestamp bucketing would
+                # count ring-absorbed bursts as "delivered in one second"
+                # while the client actually drained them over several
+                per_sec[now_ns // 1_000_000_000] = (
+                    per_sec.get(now_ns // 1_000_000_000, 0) + len(ts))
         except Exception as e:
             _log(f"stream ended: {e!r}")
         elapsed = time.time() - t0
         cpu1 = _proc_cpu_seconds(daemon.pid)
         loadgen.send_signal(signal.SIGTERM)
+        offered = None
+        try:
+            out_txt, _ = loadgen.communicate(timeout=10)
+            offered = int(out_txt.strip().splitlines()[-1])
+        except Exception:
+            loadgen.kill()
+            loadgen.wait()
 
         daemon.terminate()
         try:
@@ -141,6 +152,11 @@ def _measure(seconds: float, rate: float) -> dict:
         m = re.findall(r"kernel_dropped=(\d+)", stderr or "")
         kernel_dropped = int(m[-1]) if m else None
 
+        if count == 0:
+            # a failed stream must never masquerade as a measurement —
+            # callers treat this as SKIP/fail, the artifact is not written
+            raise RuntimeError(
+                "no events delivered: stream/decode failed before any data")
         lat = np.concatenate(lat_us) if lat_us else np.zeros(0)
         # trim partial edge seconds (warmup + shutdown skew)
         full_secs = sorted(per_sec)[1:-1]
@@ -148,6 +164,7 @@ def _measure(seconds: float, rate: float) -> dict:
                      if full_secs else count / max(elapsed, 1e-9))
         return {
             "offered_rate": "unthrottled" if rate == 0 else rate,
+            "offered_events": offered,
             "seconds_measured": round(elapsed, 1),
             "events_delivered": count,
             "events_per_sec_sustained": round(float(sustained), 1),
@@ -193,7 +210,11 @@ def main(argv=None) -> int:
     # Latency is only meaningful below saturation; a flooded single core
     # measures queue depth, not the pipeline.
     _log(f"paced leg: {args.rate:.0f} evt/s for {args.seconds:.0f}s")
-    paced = _measure(args.seconds, args.rate)
+    try:
+        paced = _measure(args.seconds, args.rate)
+    except RuntimeError as e:
+        _log(f"FAIL: paced leg produced no data ({e}); artifact NOT written")
+        return 1
     _log(f"  {paced['events_per_sec_sustained']:.0f} evt/s sustained, "
          f"p99 {paced['delivery_latency_us']['p99']}us, "
          f"cpu {paced['daemon_cpu_pct_of_one_core']}%")
@@ -201,7 +222,11 @@ def main(argv=None) -> int:
     # Leg 2 — unthrottled flood: peak delivered throughput (drops expected
     # once the 256 KiB ring outruns the consumer; they are counted).
     _log(f"flood leg: unthrottled for {args.seconds:.0f}s")
-    flood = _measure(args.seconds, 0.0)
+    try:
+        flood = _measure(args.seconds, 0.0)
+    except RuntimeError as e:
+        _log(f"FAIL: flood leg produced no data ({e}); artifact NOT written")
+        return 1
     _log(f"  {flood['events_per_sec_sustained']:.0f} evt/s sustained, "
          f"peak 1s {flood['events_per_sec_peak_1s']}, "
          f"kernel_dropped {flood['kernel_dropped']}")

@@ -16,6 +16,7 @@
 #include <sys/epoll.h>
 #include <sys/ioctl.h>
 #include <sys/mman.h>
+#include <sys/stat.h>
 #include <sys/syscall.h>
 #include <unistd.h>
 
@@ -391,16 +392,32 @@ extern "C" nerrf_capture *nerrf_capture_open(uint32_t ringbuf_bytes,
     // hand-assembled bytecode.
     std::vector<nerrf::BpfInsn> insns;
     const char *obj = getenv("NERRF_BPF_OBJ");
+    bool obj_explicit = obj && obj[0];
     char adj[4096] = {0};
-    if (!(obj && obj[0])) {
+    if (!obj_explicit) {
       ssize_t n = readlink("/proc/self/exe", adj, sizeof(adj) - 32);
       if (n > 0) {
         adj[n] = 0;
+        struct stat exe_st, obj_st;
+        int have_exe = stat(adj, &exe_st) == 0;
         char *slash = strrchr(adj, '/');
         if (slash) {
           snprintf(slash + 1, sizeof(adj) - (slash + 1 - adj),
                    "tracepoints.o");
-          if (access(adj, R_OK) == 0) obj = adj;
+          if (stat(adj, &obj_st) == 0) {
+            // freshness gate: only auto-load an object at least as new as
+            // this binary — a stale artifact predating an event-layout
+            // change would emit records the daemon misdecodes silently.
+            // (An EXPLICIT NERRF_BPF_OBJ skips this: the operator decided.)
+            if (have_exe && obj_st.st_mtime >= exe_st.st_mtime) {
+              obj = adj;
+            } else {
+              fprintf(stderr,
+                      "[capture] ignoring %s: older than this binary "
+                      "(rebuild with `make bpf`, or set NERRF_BPF_OBJ to "
+                      "force)\n", adj);
+            }
+          }
         }
       }
     }
@@ -412,19 +429,29 @@ extern "C" nerrf_capture *nerrf_capture_open(uint32_t ringbuf_bytes,
            {"dropped", c->dropped_fd},
            {"excluded", c->exclude_fd}},
           oerr, sizeof(oerr));
-      if (oi.empty()) {
+      if (!oi.empty()) {
+        insns.resize(oi.size());
+        memcpy(insns.data(), oi.data(), oi.size() * sizeof(oi[0]));
+        fprintf(stderr,
+                "[capture] using compiled BPF object %s (%zu insns)\n", obj,
+                insns.size());
+      } else if (obj_explicit) {
+        // an operator who *named* an object gets a hard, attributable error
         if (errbuf && errlen > 0)
           snprintf(errbuf, errlen, "NERRF_BPF_OBJ=%s unusable: %s", obj,
                    oerr);
         goto fail;
+      } else {
+        // auto-discovered (e.g. a stale artifact from an interrupted
+        // `make bpf`): warn and fall back — discovery must never turn a
+        // leftover file into a startup blocker
+        fprintf(stderr,
+                "[capture] ignoring unusable %s (%s); using hand-assembled "
+                "program\n", obj, oerr);
       }
-      insns.resize(oi.size());
-      memcpy(insns.data(), oi.data(), oi.size() * sizeof(oi[0]));
-      fprintf(stderr, "[capture] using compiled BPF object %s (%zu insns)\n",
-              obj, insns.size());
-    } else {
-      insns = build_program(c->events_fd, c->dropped_fd, c->exclude_fd);
     }
+    if (insns.empty())
+      insns = build_program(c->events_fd, c->dropped_fd, c->exclude_fd);
     static char log[65536];
     memset(&attr, 0, sizeof(attr));
     attr.prog.prog_type = kProgTypeTracepoint;

@@ -33,9 +33,45 @@ class DetectionResult:
     proc_scores: Dict[str, float]   # "pid:comm" → P(malicious)
     file_bytes: Dict[str, float]    # path → bytes seen moving
     detector: str = "heuristic"
+    # model detectors: every per-window node probability per file, so
+    # consumers (the adversarial eval) can compare aggregation rules from
+    # ONE model pass instead of re-scoring the trace
+    file_window_scores: Optional[Dict[str, list]] = None
 
     def flagged_files(self, threshold: float = 0.5) -> Dict[str, float]:
         return {k: v for k, v in self.file_scores.items() if v >= threshold}
+
+    def rescored(self, agg: str) -> "DetectionResult":
+        """Same detection, file scores re-aggregated from the per-window
+        scores (`agg` as in model_detect).  No-op for heuristics."""
+        if not self.file_window_scores:
+            return self
+        return dataclasses.replace(
+            self,
+            file_scores={p: aggregate_window_scores(ws, agg)
+                         for p, ws in self.file_window_scores.items()},
+            detector=f"{self.detector}[{agg}]")
+
+
+def aggregate_window_scores(scores: list, agg: str) -> float:
+    """Per-window node probabilities → one per-file score.
+
+    ``max``     the historical rule: any hot window flags the file.  FP-
+                prone — with dozens of windows per trace one noisy spike
+                permanently flags a benign file (multiple-comparisons).
+    ``robust``  the 2nd-highest window when the file was scored in ≥2
+                windows, else the single score: one outlier window can no
+                longer flag a file by itself, while a real attack (hot in
+                every window it appears) is unaffected.
+    """
+    if not scores:
+        return 0.0
+    s = sorted(scores, reverse=True)
+    if agg == "max":
+        return s[0]
+    if agg == "robust":
+        return s[1] if len(s) >= 2 else s[0]
+    raise ValueError(f"unknown aggregation {agg!r}")
 
 
 def _inode_to_path(trace: Trace) -> Dict[int, str]:
@@ -122,8 +158,13 @@ def model_detect(
     ds_cfg: Optional[DatasetConfig] = None,
     batch_size: int = 8,
     auto_capacity: bool = True,
+    agg: str = "max",
 ) -> DetectionResult:
     """Aggregate trained-model node scores across windows onto host ids.
+
+    ``agg`` picks the window→file aggregation (`aggregate_window_scores`);
+    the result also carries ``file_window_scores`` so callers can re-derive
+    any rule without re-scoring.
 
     ``auto_capacity`` sizes the graph capacities to the trace's densest
     window (power-of-two bucket, `GraphConfig.fit` policy): at projected
@@ -165,7 +206,7 @@ def model_detect(
     pid_comm = _pid_to_comm(trace)
     eval_fn = make_eval_fn(model)
 
-    file_scores: Dict[str, float] = {}
+    window_scores: Dict[str, list] = {}
     proc_scores: Dict[str, float] = {}
     file_bytes: Dict[str, float] = {}
     for i in range(0, len(samples), batch_size):
@@ -188,7 +229,7 @@ def model_detect(
                 if s["node_type"][slot] == NODE_TYPE_FILE:
                     path = ino_path.get(key)
                     if path is not None:
-                        file_scores[path] = max(file_scores.get(path, 0.0), p)
+                        window_scores.setdefault(path, []).append(p)
                 elif s["node_type"][slot] == NODE_TYPE_PROCESS:
                     name = f"{key}:{pid_comm.get(key, '?')}"
                     proc_scores[name] = max(proc_scores.get(name, 0.0), p)
@@ -197,7 +238,11 @@ def model_detect(
         if ev.valid[i] and ev.inode[i] != 0:
             path = ino_path[int(ev.inode[i])]
             file_bytes[path] = file_bytes.get(path, 0.0) + float(ev.bytes[i])
-    return DetectionResult(file_scores, proc_scores, file_bytes, detector="model")
+    file_scores = {p: aggregate_window_scores(ws, agg)
+                   for p, ws in window_scores.items()}
+    return DetectionResult(file_scores, proc_scores, file_bytes,
+                           detector=f"model[{agg}]",
+                           file_window_scores=window_scores)
 
 
 def build_undo_domain(

@@ -77,4 +77,8 @@ log "m1 recovery rc=$?"
 log "6/6 bench.py smoke (validates the driver's benchmark of record)"
 timeout 3600 python bench.py > /tmp/bench_smoke.json 2> /tmp/bench_smoke.log
 log "bench rc=$?"
+log "6b: chip-gated compiled-kernel test"
+NERRF_TEST_REAL_BACKEND=1 timeout 1200 python -m pytest \
+  tests/test_pallas_ops.py -q -k compiled_on_tpu > /tmp/pallas_tpu.log 2>&1
+log "pallas chip test rc=$?"
 log "queue done"

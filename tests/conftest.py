@@ -11,13 +11,19 @@ initializes (initialization is lazy; import-time registration is not).
 
 import os
 
+# NERRF_TEST_REAL_BACKEND=1 runs against whatever backend the host offers —
+# for the chip-gated tests (test_pallas_ops.py compiled-Mosaic check) that
+# the TPU queue invokes; everything else keeps the virtual CPU mesh.
+_real = os.environ.get("NERRF_TEST_REAL_BACKEND") == "1"
+
 _flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
+if not _real and "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+if not _real:
+    jax.config.update("jax_platforms", "cpu")
 
 
 def pytest_configure(config):

@@ -241,3 +241,27 @@ def test_model_detect_auto_capacity_covers_dense_traces():
     # every encrypted file is scoreable (present in the detection universe)
     enc = [p for p in det.file_scores if p.endswith(".lockbit3")]
     assert len(enc) >= 15, f"only {len(enc)} ransom files visible"
+
+
+def test_window_score_aggregation_rules():
+    """`robust` must ignore a single-window outlier but keep consistently
+    hot files at full score; both rules agree on single-window files."""
+    from nerrf_tpu.pipeline import DetectionResult, aggregate_window_scores
+
+    assert aggregate_window_scores([0.9, 0.1, 0.1], "max") == 0.9
+    assert aggregate_window_scores([0.9, 0.1, 0.1], "robust") == 0.1
+    assert aggregate_window_scores([0.9, 0.8, 0.7], "robust") == 0.8
+    assert aggregate_window_scores([0.6], "robust") == 0.6
+    assert aggregate_window_scores([], "max") == 0.0
+
+    det = DetectionResult(
+        file_scores={"/a": 0.9, "/b": 0.95},
+        proc_scores={}, file_bytes={}, detector="model[max]",
+        file_window_scores={"/a": [0.9, 0.05], "/b": [0.95, 0.9, 0.85]})
+    r = det.rescored("robust")
+    assert r.file_scores["/a"] == 0.05      # outlier window neutralized
+    assert r.file_scores["/b"] == 0.9       # persistent threat kept
+    assert r.detector.endswith("[robust]")
+    # heuristic results (no window scores) pass through unchanged
+    h = DetectionResult({"/x": 1.0}, {}, {})
+    assert h.rescored("robust") is h
