@@ -102,3 +102,31 @@ def test_reader_failure_propagates(corpus_dir, tmp_path):
                       eval_every=0)
     with pytest.raises(RuntimeError, match="shard read failed"):
         train_sharded_stream(sc, cfg)
+
+
+def test_auto_fit_guarantees_zero_drops(corpus_dir):
+    """The r3 contract: generation measures the densest window, sizes
+    capacities up from the seed config, and records zero drops — the r2
+    corpus silently truncated attack bursts at fixed 256n/512e."""
+    man = json.loads((corpus_dir / "manifest.json").read_text())
+    fit = man["auto_fit"]
+    cap = man["graph_capacity"]
+    assert man["dropped"] == {"events": 0, "nodes": 0, "edges": 0,
+                              "windows": 0}
+    assert cap["max_nodes"] >= fit["max_window_nodes"]
+    assert cap["max_edges"] >= fit["max_window_edges"]
+    # shard arrays really are at the fitted capacities
+    shard = next(s["name"] for s in man["shards"] if s["kind"] == "shard")
+    nf = np.load(corpus_dir / shard / "node_feat.npy", mmap_mode="r")
+    assert nf.shape[1] == cap["max_nodes"]
+
+
+def test_auto_fit_off_keeps_seed_capacities(tmp_path):
+    """auto_fit=False must preserve the caller's exact capacities (the
+    measuring pre-pass is skipped entirely)."""
+    spec = CorpusSpec(hours=0.05, duration_sec=90.0, num_target_files=4,
+                      benign_rate_hz=6.0, shard_windows=8,
+                      eval_fraction=0.0, auto_fit=False)
+    man = generate_corpus(tmp_path / "c", spec, dataset=SMALL)
+    assert man["auto_fit"] is None
+    assert man["graph_capacity"] == {"max_nodes": 64, "max_edges": 128}
