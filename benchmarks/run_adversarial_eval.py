@@ -64,13 +64,13 @@ def _attacked_files(trace) -> tuple[set, set]:
     victims (detection-rate denominator); `attack_touched` additionally
     includes every path an attack event wrote/renamed (ransom note, the
     pre-rename names), so flagging those does not count as a false undo."""
-    from nerrf_tpu.schema.events import Syscall
+    from nerrf_tpu.schema.events import MUTATING_SYSCALLS
 
     ev, st = trace.events, trace.strings
     encrypted, touched = set(), set()
     if trace.labels is None:
         return encrypted, touched
-    mutating = (int(Syscall.WRITE), int(Syscall.RENAME), int(Syscall.UNLINK))
+    mutating = MUTATING_SYSCALLS
     for i in range(len(ev)):
         if not ev.valid[i] or trace.labels[i] < 0.5:
             continue
@@ -97,7 +97,7 @@ def _benign_touched_files(trace) -> set:
     for i in range(len(ev)):
         if not ev.valid[i] or (labels is not None and labels[i] >= 0.5):
             continue
-        if int(ev.syscall[i]) in (int(Syscall.WRITE), int(Syscall.RENAME)):
+        if int(ev.syscall[i]) in (int(Syscall.WRITE), int(Syscall.RENAME)):  # noqa: keep narrower than MUTATING_SYSCALLS: an unlinked benign file has no surviving content an undo could clobber
             p = st.lookup(int(ev.new_path_id[i])) or st.lookup(int(ev.path_id[i]))
             if p:
                 out.add(p)

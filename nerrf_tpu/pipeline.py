@@ -22,7 +22,11 @@ from nerrf_tpu.graph.builder import NODE_TYPE_FILE, NODE_TYPE_PROCESS
 from nerrf_tpu.models import NerrfNet
 from nerrf_tpu.planner.domain import UndoDomain
 from nerrf_tpu.rollback.store import Manifest
-from nerrf_tpu.schema.events import Syscall, is_suspicious_extension
+from nerrf_tpu.schema.events import (
+    MUTATING_SYSCALLS,
+    Syscall,
+    is_suspicious_extension,
+)
 from nerrf_tpu.train.data import DatasetConfig, windows_of_trace
 from nerrf_tpu.train.loop import make_eval_fn
 
@@ -43,13 +47,16 @@ class DetectionResult:
 
     def rescored(self, agg: str) -> "DetectionResult":
         """Same detection, file scores re-aggregated from the per-window
-        scores (`agg` as in model_detect).  No-op for heuristics."""
+        scores (`agg` as in model_detect).  Only files already present in
+        ``file_scores`` are re-scored — re-aggregation must not resurrect
+        files the mutation filter excluded.  No-op for heuristics."""
         if not self.file_window_scores:
             return self
         return dataclasses.replace(
             self,
-            file_scores={p: aggregate_window_scores(ws, agg)
-                         for p, ws in self.file_window_scores.items()},
+            file_scores={p: aggregate_window_scores(
+                self.file_window_scores.get(p, []), agg)
+                for p in self.file_scores},
             detector=f"{self.detector}[{agg}]")
 
 
@@ -234,12 +241,32 @@ def model_detect(
                     name = f"{key}:{pid_comm.get(key, '?')}"
                     proc_scores[name] = max(proc_scores.get(name, 0.0), p)
     ev = trace.events
+    mutated: set = set()
     for i in range(len(ev)):
-        if ev.valid[i] and ev.inode[i] != 0:
+        if not ev.valid[i]:
+            continue
+        if ev.inode[i] != 0:
             path = ino_path[int(ev.inode[i])]
             file_bytes[path] = file_bytes.get(path, 0.0) + float(ev.bytes[i])
+        if int(ev.syscall[i]) in MUTATING_SYSCALLS:
+            # gate on the inode-canonical path first (file_scores is keyed
+            # on it via _inode_to_path); raw event strings as well, since a
+            # rename's OLD name is a distinct undo target
+            if ev.inode[i] != 0:
+                mutated.add(ino_path[int(ev.inode[i])])
+            for pid_field in (ev.path_id[i], ev.new_path_id[i]):
+                p = trace.strings.lookup(int(pid_field))
+                if p:
+                    mutated.add(p)
+    # Undo candidacy requires mutation: a file nothing ever wrote, renamed
+    # or unlinked has no pre-attack state to restore — rolling it back is a
+    # false-positive undo BY DEFINITION.  The model rightly scores recon
+    # reads (/etc/passwd, /proc/net/tcp) as attack-involved, and that
+    # signal stays visible in file_window_scores; it just cannot nominate
+    # them for rollback.  (Measured: every standard-scenario FP the r2/r3
+    # evals charged to the model was a never-mutated recon read.)
     file_scores = {p: aggregate_window_scores(ws, agg)
-                   for p, ws in window_scores.items()}
+                   for p, ws in window_scores.items() if p in mutated}
     return DetectionResult(file_scores, proc_scores, file_bytes,
                            detector=f"model[{agg}]",
                            file_window_scores=window_scores)

@@ -56,6 +56,18 @@ class Syscall(enum.IntEnum):
             return cls.OTHER
 
 
+# Syscalls that change file CONTENT/existence — the only events that can
+# create an undo obligation (reads/opens/stats observe; they never need
+# rolling back).  ONE definition, shared by the detector's undo-candidacy
+# gate (pipeline.model_detect) and the adversarial eval's FP-undo ground
+# truth (benchmarks/run_adversarial_eval.py) — those two must never drift,
+# or the KPI silently changes meaning.  CHMOD/MKDIR are excluded because
+# the rollback executor restores content, not metadata/dir trees
+# (rollback/sandbox.py's replay dispatch).
+MUTATING_SYSCALLS = frozenset(
+    (int(Syscall.WRITE), int(Syscall.RENAME), int(Syscall.UNLINK)))
+
+
 class OpenFlags(enum.IntEnum):
     """Access mode for openat, mirroring `proto/trace.proto:25-29`."""
 

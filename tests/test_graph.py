@@ -265,3 +265,58 @@ def test_window_score_aggregation_rules():
     # heuristic results (no window scores) pass through unchanged
     h = DetectionResult({"/x": 1.0}, {}, {})
     assert h.rescored("robust") is h
+
+
+def test_model_detect_gates_undo_candidacy_on_mutation():
+    """Files nothing ever wrote/renamed/unlinked (recon reads like
+    /etc/passwd) must not appear in file_scores — they have no pre-attack
+    state to restore, so flagging them is a false-positive undo by
+    definition.  Their window scores stay visible for diagnostics."""
+    import dataclasses as dc
+
+    import jax
+    import jax.numpy as jnp
+
+    from nerrf_tpu.data.synth import SimConfig, simulate_trace
+    from nerrf_tpu.graph.builder import GraphConfig
+    from nerrf_tpu.models import JointConfig, NerrfNet
+    from nerrf_tpu.pipeline import model_detect
+    from nerrf_tpu.schema.events import MUTATING_SYSCALLS
+    from nerrf_tpu.train.data import DatasetConfig, windows_of_trace
+    from nerrf_tpu.train.loop import model_inputs
+
+    tr = simulate_trace(SimConfig(duration_sec=60.0, benign_rate_hz=20.0,
+                                  num_target_files=8, attack=True,
+                                  attack_start_sec=15.0, seed=21))
+    cfg = JointConfig(gnn=dc.replace(JointConfig().gnn, hidden=16, num_layers=2),
+                      lstm=dc.replace(JointConfig().lstm, hidden=16, num_layers=1))
+    model = NerrfNet(cfg)
+    ds = DatasetConfig(graph=GraphConfig(max_nodes=256, max_edges=512),
+                       seq_len=20, max_seqs=16)
+    one = {k: jnp.asarray(v) for k, v in windows_of_trace(tr, ds)[0].items()}
+    params = model.init(jax.random.PRNGKey(0), *model_inputs(one))["params"]
+
+    det = model_detect(tr, params, model, ds_cfg=ds, batch_size=2)
+    from nerrf_tpu.pipeline import _inode_to_path
+
+    ev, st = tr.events, tr.strings
+    ino_path = _inode_to_path(tr)
+    mutated = set()
+    for i in range(len(ev)):
+        if ev.valid[i] and int(ev.syscall[i]) in MUTATING_SYSCALLS:
+            if ev.inode[i] != 0:
+                mutated.add(ino_path[int(ev.inode[i])])
+            for f in (ev.path_id[i], ev.new_path_id[i]):
+                p = st.lookup(int(f))
+                if p:
+                    mutated.add(p)
+    # the trace's recon phase reads /etc/passwd etc.; they must be scored
+    # in windows but absent from undo candidacy
+    assert det.file_window_scores, "window scores must be retained"
+    non_mutated_scored = [p for p in det.file_window_scores
+                          if p not in mutated]
+    assert non_mutated_scored, "scenario should include read-only files"
+    for p in det.file_scores:
+        assert p in mutated, f"non-mutated file {p} nominated for undo"
+    # rescoring must not resurrect filtered files
+    assert set(det.rescored("robust").file_scores) == set(det.file_scores)
