@@ -39,9 +39,18 @@ def main() -> int:
                     default="auto")
     args = ap.parse_args()
 
-    from nerrf_tpu.utils import enable_compilation_cache
+    from nerrf_tpu.utils import enable_compilation_cache, probe_backend
 
     enable_compilation_cache()
+    # bounded reachability check BEFORE the first in-process jax op
+    # (ValueNet.create would otherwise block forever on a wedged tunnel)
+    ok, detail, _ = probe_backend(timeout_sec=90.0)
+    if not ok:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        print(f"[bench] accelerator unreachable ({detail}); CPU fallback",
+              file=sys.stderr, flush=True)
     from nerrf_tpu.pipeline import build_undo_domain, heuristic_detect
     from nerrf_tpu.planner import MCTSConfig, make_planner
     from nerrf_tpu.planner.value_net import ValueNet
@@ -81,9 +90,10 @@ def main() -> int:
         value = ValueNet.create()
         planner_cfg = MCTSConfig(num_simulations=args.simulations)
         if args.planner != "host":
-            import jax
+            from nerrf_tpu.utils import safe_default_backend
 
-            if args.planner == "device" or jax.default_backend() in ("tpu", "gpu"):
+            if (args.planner == "device"
+                    or safe_default_backend() in ("tpu", "gpu")):  # cheap: initialized above
                 from nerrf_tpu.planner.device_mcts import DeviceMCTS
 
                 t_warm = time.perf_counter()

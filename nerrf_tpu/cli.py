@@ -111,9 +111,22 @@ def cmd_train_detector(args) -> int:
 def cmd_undo(args) -> int:
     # undo is the MTTR-critical path and compiles detector + planner
     # programs — the persistent cache makes restart N+1's compiles free
-    from nerrf_tpu.utils import enable_compilation_cache
+    from nerrf_tpu.utils import enable_compilation_cache, probe_backend
 
     enable_compilation_cache()
+    # An incident responder must get a rollback even when the accelerator
+    # link is dead: establish reachability in a bounded probe and force the
+    # CPU backend if it fails — the first in-process jax op would otherwise
+    # block forever on a wedged tunnel (observed with the axon relay).
+    # Bounded cost on a healthy host; skip with --no-probe.
+    if not getattr(args, "no_probe", False):
+        ok, detail, _ = probe_backend(timeout_sec=60.0)
+        if not ok:
+            import jax
+
+            jax.config.update("jax_platforms", "cpu")
+            _log(f"accelerator unreachable ({detail}); running the undo "
+                 f"pipeline on CPU")
     from nerrf_tpu.data.loaders import load_trace_jsonl
     from nerrf_tpu.pipeline import build_undo_domain, heuristic_detect, model_detect
     from nerrf_tpu.planner import MCTSConfig, make_planner
@@ -383,6 +396,10 @@ def main(argv=None) -> int:
                         "KPI path")
     p.add_argument("--dry-run", action="store_true")
     p.add_argument("--no-gate", action="store_true")
+    p.add_argument("--no-probe", action="store_true",
+                   help="skip the bounded accelerator-reachability probe "
+                        "(a resident daemon with a warm backend wants this; "
+                        "one-shot undo on a possibly-wedged host does not)")
     p.set_defaults(fn=cmd_undo)
 
     p = sub.add_parser("status", help="incident state")

@@ -20,9 +20,10 @@ def make_planner(domain, value, cfg: MCTSConfig, kind: str = "auto"):
     link), and the whole-search-on-device planner exists precisely to cut
     that, so an available chip must be the KPI path, not an opt-in."""
     if kind == "auto":
-        import jax
+        from nerrf_tpu.utils import safe_default_backend
 
-        kind = "device" if jax.default_backend() in ("tpu", "gpu") else "host"
+        kind = ("device" if safe_default_backend() in ("tpu", "gpu")
+                else "host")
     if kind == "device":
         return DeviceMCTS(
             domain, cfg,

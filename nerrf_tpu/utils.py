@@ -93,3 +93,42 @@ def enable_compilation_cache() -> None:
         jax.config.update("jax_compilation_cache_dir", cache)
     except Exception:
         pass  # old jax or read-only home: run uncached
+
+
+def safe_default_backend(timeout_sec: float = 90.0) -> str:
+    """The default backend's platform name without risking an unbounded
+    hang: if this process already initialized a backend, ask it directly
+    (free); otherwise establish reachability via the bounded subprocess
+    probe first.  Returns "cpu" when the probe fails — callers choosing a
+    planner/device path degrade to the host path instead of hanging, which
+    is exactly what an incident responder needs from a wedged tunnel.
+    (Found live: `make_planner(kind='auto')` blocked the m0 recovery bench
+    for minutes on a dead axon relay.)"""
+    initialized = False
+    try:
+        from jax._src import xla_bridge
+
+        if hasattr(xla_bridge, "backends_are_initialized"):
+            initialized = bool(xla_bridge.backends_are_initialized())
+        else:  # older jax: fall back to the private registry
+            initialized = bool(xla_bridge._backends)
+    except Exception as e:
+        # visible degradation: without the peek every call pays a full
+        # subprocess probe even in a warm process
+        print(f"[nerrf] backend-initialized peek failed "
+              f"({type(e).__name__}: {e}); probing in a subprocess",
+              file=sys.stderr, flush=True)
+    if initialized:
+        import jax
+
+        return jax.default_backend()
+    ok, detail, _ = probe_backend(timeout_sec=timeout_sec)
+    if not ok:
+        # the demotion must be diagnosable, not mysterious slowness
+        print(f"[nerrf] accelerator unreachable ({detail}); "
+              f"degrading to the CPU/host path", file=sys.stderr, flush=True)
+        return "cpu"
+    # reachable: the in-process init that follows is expected to succeed
+    import jax
+
+    return jax.default_backend()
