@@ -13,9 +13,12 @@ import numpy as np
 
 
 def main() -> int:
-    from nerrf_tpu.utils import enable_compilation_cache
+    from nerrf_tpu.utils import enable_compilation_cache, ensure_backend_or_cpu
 
     enable_compilation_cache()
+    # bounded reachability check before the first in-process jax op — the
+    # probe must degrade to CPU on a wedged tunnel, not hang at value-net init
+    ensure_backend_or_cpu("probe", timeout_sec=90.0)
     from nerrf_tpu.planner import MCTSConfig, MCTSPlanner, UndoDomain
     from nerrf_tpu.planner.value_net import ValueNet
 

@@ -39,18 +39,12 @@ def main() -> int:
                     default="auto")
     args = ap.parse_args()
 
-    from nerrf_tpu.utils import enable_compilation_cache, probe_backend
+    from nerrf_tpu.utils import enable_compilation_cache, ensure_backend_or_cpu
 
     enable_compilation_cache()
     # bounded reachability check BEFORE the first in-process jax op
     # (ValueNet.create would otherwise block forever on a wedged tunnel)
-    ok, detail, _ = probe_backend(timeout_sec=90.0)
-    if not ok:
-        import jax
-
-        jax.config.update("jax_platforms", "cpu")
-        print(f"[bench] accelerator unreachable ({detail}); CPU fallback",
-              file=sys.stderr, flush=True)
+    ensure_backend_or_cpu("bench", timeout_sec=90.0)
     from nerrf_tpu.pipeline import build_undo_domain, heuristic_detect
     from nerrf_tpu.planner import MCTSConfig, make_planner
     from nerrf_tpu.planner.value_net import ValueNet

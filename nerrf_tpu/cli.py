@@ -111,7 +111,7 @@ def cmd_train_detector(args) -> int:
 def cmd_undo(args) -> int:
     # undo is the MTTR-critical path and compiles detector + planner
     # programs — the persistent cache makes restart N+1's compiles free
-    from nerrf_tpu.utils import enable_compilation_cache, probe_backend
+    from nerrf_tpu.utils import enable_compilation_cache, ensure_backend_or_cpu
 
     enable_compilation_cache()
     # An incident responder must get a rollback even when the accelerator
@@ -120,13 +120,7 @@ def cmd_undo(args) -> int:
     # block forever on a wedged tunnel (observed with the axon relay).
     # Bounded cost on a healthy host; skip with --no-probe.
     if not getattr(args, "no_probe", False):
-        ok, detail, _ = probe_backend(timeout_sec=60.0)
-        if not ok:
-            import jax
-
-            jax.config.update("jax_platforms", "cpu")
-            _log(f"accelerator unreachable ({detail}); running the undo "
-                 f"pipeline on CPU")
+        ensure_backend_or_cpu("nerrf", timeout_sec=60.0)
     from nerrf_tpu.data.loaders import load_trace_jsonl
     from nerrf_tpu.pipeline import build_undo_domain, heuristic_detect, model_detect
     from nerrf_tpu.planner import MCTSConfig, make_planner

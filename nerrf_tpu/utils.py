@@ -122,13 +122,28 @@ def safe_default_backend(timeout_sec: float = 90.0) -> str:
         import jax
 
         return jax.default_backend()
-    ok, detail, _ = probe_backend(timeout_sec=timeout_sec)
-    if not ok:
-        # the demotion must be diagnosable, not mysterious slowness
-        print(f"[nerrf] accelerator unreachable ({detail}); "
-              f"degrading to the CPU/host path", file=sys.stderr, flush=True)
+    if not ensure_backend_or_cpu("nerrf", timeout_sec=timeout_sec):
         return "cpu"
     # reachable: the in-process init that follows is expected to succeed
     import jax
 
     return jax.default_backend()
+
+
+def ensure_backend_or_cpu(tag: str, timeout_sec: float = 90.0) -> bool:
+    """Bounded reachability probe; on failure FORCE the CPU platform so the
+    caller's next in-process jax op runs instead of hanging on the dead
+    accelerator.  Returns True when the accelerator is reachable.  The one
+    shared implementation of the probe-then-degrade block every offline
+    entry point (undo CLI, recovery bench, planner probe) needs."""
+    ok, detail, _ = probe_backend(timeout_sec=timeout_sec)
+    if not ok:
+        try:
+            import jax
+
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass  # backend already initialized: nothing left to force
+        print(f"[{tag}] accelerator unreachable ({detail}); "
+              f"degrading to the CPU path", file=sys.stderr, flush=True)
+    return ok
