@@ -33,7 +33,26 @@ def main() -> None:
     # first (nerrf_tpu.utils.probe_backend — stdlib-only import).
     from nerrf_tpu.utils import probe_backend
 
-    ok, detail, _ = probe_backend(timeout_sec=180.0)
+    # NERRF_BENCH_PLATFORM=cpu: dress-rehearsal mode — run the whole bench
+    # on the named platform without touching the accelerator (used to
+    # validate the bench code itself while the tunnel is down; the emitted
+    # numbers carry "backend": "cpu" so they cannot be mistaken for chip
+    # results)
+    forced = os.environ.get("NERRF_BENCH_PLATFORM")
+    if forced == "cpu":
+        # the only probe-free value: CPU cannot hang on a dead tunnel;
+        # forcing an accelerator platform still goes through the probe,
+        # preserving the one-JSON-line-either-way contract
+        import jax
+
+        jax.config.update("jax_platforms", forced)
+        ok, detail = True, f"forced platform {forced}"
+    else:
+        if forced:
+            import jax
+
+            jax.config.update("jax_platforms", forced)
+        ok, detail, _ = probe_backend(timeout_sec=180.0)
     if not ok:
         print(json.dumps({
             "metric": "nerrfnet_train_steps_per_sec",

@@ -138,8 +138,13 @@ def main() -> int:
         )
         clean_total = max(len(manifest.files) - len(encrypted), 0)
         fp_rate = fp_reverted / clean_total if clean_total else 0.0
+        import jax
+
         result = {
             "scale": args.scale,
+            # provenance: CPU-fallback artifacts must be distinguishable
+            # from chip artifacts at the schema level, not just in prose
+            "backend": jax.default_backend(),
             "attack": {
                 "files": len(encrypted),
                 "total_bytes": total_bytes,

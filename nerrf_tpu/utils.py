@@ -122,7 +122,15 @@ def safe_default_backend(timeout_sec: float = 90.0) -> str:
         import jax
 
         return jax.default_backend()
-    if not ensure_backend_or_cpu("nerrf", timeout_sec=timeout_sec):
+    ok, detail, _ = probe_backend(timeout_sec=timeout_sec)
+    if not ok:
+        # Report, but do NOT force jax_platforms here: this is a query, and
+        # permanently pinning a long-lived process to CPU over one transient
+        # probe failure would out-live the blip.  Entry points that go on to
+        # issue jax ops guard themselves with ensure_backend_or_cpu (which
+        # does force) before ever reaching this path.
+        print(f"[nerrf] accelerator unreachable ({detail}); "
+              f"reporting the CPU/host path", file=sys.stderr, flush=True)
         return "cpu"
     # reachable: the in-process init that follows is expected to succeed
     import jax
