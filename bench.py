@@ -116,8 +116,17 @@ def main() -> None:
     log(f"[bench] dataset: {len(train_ds)} train / {len(eval_ds)} eval windows")
 
     # --- JAX training -------------------------------------------------------
-    cfg = TrainConfig(model=JointConfig(), batch_size=8, num_steps=200,
-                      learning_rate=2e-3, warmup_steps=30, seed=0)
+    # NERRF_BENCH_STEPS shrinks the run for dress rehearsals (validating
+    # every leg end-to-end where 200 flagship steps would blow the clock,
+    # e.g. CPU); the metric of record always uses the default
+    try:
+        bench_steps = max(2, int(os.environ.get("NERRF_BENCH_STEPS", "200")))
+    except ValueError:
+        bench_steps = 200
+    cfg = TrainConfig(model=JointConfig(), batch_size=8,
+                      num_steps=bench_steps,
+                      learning_rate=2e-3, warmup_steps=min(30, bench_steps // 2),
+                      seed=0)
     model = NerrfNet(cfg.model)
     rng = jax.random.PRNGKey(0)
 
@@ -193,7 +202,7 @@ def main() -> None:
             sstate, sloss, srng = step_fn(sstate, placed, jax.random.PRNGKey(3))
             jax.block_until_ready(sloss)
             t0 = time.perf_counter()
-            s_steps = 50
+            s_steps = min(50, max(3, bench_steps // 4))
             for _ in range(s_steps):
                 sstate, sloss, srng = step_fn(sstate, placed, srng)
             jax.block_until_ready(sloss)
@@ -273,15 +282,22 @@ def main() -> None:
                 "provenance": "python -m nerrf_tpu.train.run "
                               "--experiment joint-100h",
             }
+        # preference: newest chip artifact, then the CPU probe artifact
+        # (current code, small model), then older chip/CPU rounds — the r2
+        # file predates the mutation gate + hardened corpus and would
+        # misreport the current system
         adv = next((p for p in (
-            os.path.join(art_dir, f"adversarial_r{n}.json")
-            for n in (4, 3, 2)) if os.path.exists(p)), "")
+            os.path.join(art_dir, name)
+            for name in ("adversarial_r4.json", "adversarial_r3.json",
+                         "adversarial_probe_cpu.json", "adversarial_r2.json"))
+            if os.path.exists(p)), "")
         if adv:
             r = json.load(open(adv))
             artifacts["adversarial"] = {
                 "fp_undo_rate_worst": r.get("kpi", {}).get(
                     "fp_undo_rate_worst_model"),
                 "fp_undo_met": r.get("kpi", {}).get("fp_undo_met"),
+                "source": os.path.basename(adv),
                 "provenance": "python benchmarks/run_adversarial_eval.py",
             }
     except Exception as e:
@@ -307,6 +323,10 @@ def main() -> None:
         "vs_baseline_note": "same-arch torch on this host's CPU (no CUDA in "
                             "env; chip-side metric of record is mfu_pct)",
         "backend": backend,
+        # a shrunk rehearsal must be distinguishable from the metric of
+        # record, exactly like the forced-platform stamp
+        "num_steps": cfg.num_steps,
+        "rehearsal": (cfg.num_steps != 200) or bool(forced) or None,
         "model_flops_per_step": round(step_flops) if step_flops else None,
         "achieved_tflops":
             round(achieved_tflops, 2) if achieved_tflops else None,
