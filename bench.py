@@ -6,7 +6,8 @@ Prints ONE JSON line:
 
 - value: steady-state train-steps/sec of the flagship joint model
   (28-layer ~2.2M-param GraphSAGE-T + 2×256 BiLSTM, batch of 8 window graphs
-  at full shapes: 256 nodes / 512 edges / 128 sequences × 100 events) on the
+  at the corpus's fitted capacities — 1024 nodes / 2048 edges / 128
+  sequences × 100 events) on the
   default JAX backend (the real TPU chip under the driver).
 - vs_baseline: ratio vs the same architecture implemented in PyTorch
   (`nerrf_tpu/bench/torch_baseline.py`) measured on this host — the
