@@ -15,6 +15,12 @@ Prints ONE JSON line:
   which in this CUDA-less environment runs on CPU.
 - extras: held-out-trace edge ROC-AUC (quality gate ≥0.90) and context.
 
+When the accelerator tunnel is unreachable the bench degrades to a short
+CPU measurement instead of emitting null: the line then carries
+"backend": "cpu", a "degraded" field with the probe failure, and
+"rehearsal": true, and the process exits 1 so no consumer can mistake it
+for the chip number of record.
+
 Skip the torch leg with NERRF_BENCH_SKIP_TORCH=1 (vs_baseline then null).
 """
 
@@ -32,7 +38,7 @@ def main() -> None:
     # in-process device call blocks forever.  The bench must print its one
     # JSON line either way, so establish reachability in a killable child
     # first (nerrf_tpu.utils.probe_backend — stdlib-only import).
-    from nerrf_tpu.utils import probe_backend
+    from nerrf_tpu.utils import ensure_backend_or_cpu
 
     # NERRF_BENCH_PLATFORM=cpu: dress-rehearsal mode — run the whole bench
     # on the named platform without touching the accelerator (used to
@@ -53,17 +59,21 @@ def main() -> None:
             import jax
 
             jax.config.update("jax_platforms", forced)
-        ok, detail, _ = probe_backend(timeout_sec=180.0)
-    if not ok:
-        print(json.dumps({
-            "metric": "nerrfnet_train_steps_per_sec",
-            "value": None,
-            "unit": "steps/s",
-            "vs_baseline": None,
-            "error": f"backend unreachable: {detail} — no metrics "
-                     "measurable on this host right now",
-        }))
-        sys.exit(1)
+        # r2 emitted a null line on probe failure and the round ended with
+        # no number of record at all.  A CPU measurement with explicit
+        # provenance is strictly more informative than null: it proves the
+        # whole harness end-to-end, and the "backend"/"degraded"/"rehearsal"
+        # stamps plus exit code 1 keep it from ever being mistaken for a
+        # chip result.  ensure_backend_or_cpu forces the CPU platform so
+        # nothing below can hang on the dead tunnel.
+        ok, detail = ensure_backend_or_cpu("bench", timeout_sec=180.0)
+    degraded = None if ok else detail
+    if degraded:
+        # force, not setdefault: a preset NERRF_BENCH_STEPS=200 (the
+        # metric-of-record default) must not make the degraded run grind
+        # through 200 flagship-shape steps on CPU — the degraded contract
+        # is a short measured line, always
+        os.environ["NERRF_BENCH_STEPS"] = "8"
     from nerrf_tpu.utils import enable_compilation_cache
 
     enable_compilation_cache()
@@ -327,7 +337,9 @@ def main() -> None:
         # a shrunk rehearsal must be distinguishable from the metric of
         # record, exactly like the forced-platform stamp
         "num_steps": cfg.num_steps,
-        "rehearsal": (cfg.num_steps != 200) or bool(forced) or None,
+        "rehearsal":
+            (cfg.num_steps != 200) or bool(forced) or bool(degraded) or None,
+        "degraded": degraded,
         "model_flops_per_step": round(step_flops) if step_flops else None,
         "achieved_tflops":
             round(achieved_tflops, 2) if achieved_tflops else None,
@@ -348,6 +360,10 @@ def main() -> None:
         "artifacts": artifacts or None,
         "wall_seconds": round(time.perf_counter() - t_wall, 1),
     }))
+    if degraded:
+        # the old probe-failure contract: rc != 0 means "not the chip
+        # number of record" — kept, now with a measured line above it
+        sys.exit(1)
 
 
 if __name__ == "__main__":

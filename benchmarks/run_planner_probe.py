@@ -18,7 +18,7 @@ def main() -> int:
     enable_compilation_cache()
     # bounded reachability check before the first in-process jax op — the
     # probe must degrade to CPU on a wedged tunnel, not hang at value-net init
-    ensure_backend_or_cpu("probe", timeout_sec=90.0)
+    ensure_backend_or_cpu("probe", timeout_sec=150.0)
     from nerrf_tpu.planner import MCTSConfig, MCTSPlanner, UndoDomain
     from nerrf_tpu.planner.value_net import ValueNet
 

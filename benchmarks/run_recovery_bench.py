@@ -44,7 +44,7 @@ def main() -> int:
     enable_compilation_cache()
     # bounded reachability check BEFORE the first in-process jax op
     # (ValueNet.create would otherwise block forever on a wedged tunnel)
-    ensure_backend_or_cpu("bench", timeout_sec=90.0)
+    ensure_backend_or_cpu("bench", timeout_sec=150.0)
     from nerrf_tpu.pipeline import build_undo_domain, heuristic_detect
     from nerrf_tpu.planner import MCTSConfig, make_planner
     from nerrf_tpu.planner.value_net import ValueNet

@@ -120,7 +120,7 @@ def cmd_undo(args) -> int:
     # block forever on a wedged tunnel (observed with the axon relay).
     # Bounded cost on a healthy host; skip with --no-probe.
     if not getattr(args, "no_probe", False):
-        ensure_backend_or_cpu("nerrf", timeout_sec=60.0)
+        ensure_backend_or_cpu("nerrf", timeout_sec=120.0)
     from nerrf_tpu.data.loaders import load_trace_jsonl
     from nerrf_tpu.pipeline import build_undo_domain, heuristic_detect, model_detect
     from nerrf_tpu.planner import MCTSConfig, make_planner

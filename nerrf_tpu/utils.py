@@ -15,7 +15,8 @@ import tempfile
 
 
 def probe_backend(timeout_sec: float = 120.0,
-                  _code: str | None = None) -> tuple[bool, str, int]:
+                  _code: str | None = None,
+                  platform: str | None = None) -> tuple[bool, str, int]:
     """Initialize the JAX backend in a bounded, killable subprocess.
 
     A dead accelerator tunnel (seen twice with the axon relay) makes the
@@ -34,8 +35,32 @@ def probe_backend(timeout_sec: float = 120.0,
     child's program (test hook: exercising the timeout/parse paths must
     not depend on a real backend).
     """
+    # Enumeration alone is not reachability: the axon relay has been seen
+    # half-up, answering device enumeration while its remote_compile
+    # endpoint refused connections (2026-07-31: bench got a device handle,
+    # then hung ~30 min in the first compile).  The probe therefore also
+    # compiles and runs a tiny jitted op so success means the full
+    # enumerate→compile→execute path works.
+    # ``platform`` pins the child via jax.config.update — the only override
+    # that works here: the accelerator plugin's registration (interpreter
+    # start, via sitecustomize) re-sets jax_platforms, so the JAX_PLATFORMS
+    # environment variable is silently ignored by child processes.
+    pin = (f"import jax; jax.config.update('jax_platforms', {platform!r}); "
+           if platform else "")
+    # Deliberately NO persistent compilation cache in the child: a cache
+    # hit would skip the remote_compile round-trip and report a half-up
+    # relay (enumeration serving, remote_compile refused — the observed
+    # failure mode) as healthy.  Probe success must mean a FRESH
+    # enumerate->compile->execute worked, so each probe pays the tiny
+    # compile; real workloads amortize theirs via enable_compilation_cache.
     code = _code if _code is not None else (
-        "import jax; d = jax.devices(); "
+        pin +
+        # an inherited JAX_COMPILATION_CACHE_DIR would cache-hit the probe
+        # op and skip remote_compile — disable it in the child explicitly
+        "import jax; jax.config.update('jax_compilation_cache_dir', None); "
+        "import jax.numpy as jnp; d = jax.devices(); "
+        "y = jax.jit(lambda a: a @ a)(jnp.ones((8, 8), jnp.float32)); "
+        "y.block_until_ready(); "
         "print('PROBE_OK %d %s x%d (%s)' % "
         "(len(d), jax.default_backend(), len(d), d[0].device_kind))")
     try:
@@ -95,7 +120,7 @@ def enable_compilation_cache() -> None:
         pass  # old jax or read-only home: run uncached
 
 
-def safe_default_backend(timeout_sec: float = 90.0) -> str:
+def safe_default_backend(timeout_sec: float = 150.0) -> str:
     """The default backend's platform name without risking an unbounded
     hang: if this process already initialized a backend, ask it directly
     (free); otherwise establish reachability via the bounded subprocess
@@ -138,12 +163,18 @@ def safe_default_backend(timeout_sec: float = 90.0) -> str:
     return jax.default_backend()
 
 
-def ensure_backend_or_cpu(tag: str, timeout_sec: float = 90.0) -> bool:
+def ensure_backend_or_cpu(tag: str,
+                          timeout_sec: float = 150.0) -> tuple[bool, str]:
     """Bounded reachability probe; on failure FORCE the CPU platform so the
     caller's next in-process jax op runs instead of hanging on the dead
-    accelerator.  Returns True when the accelerator is reachable.  The one
-    shared implementation of the probe-then-degrade block every offline
-    entry point (undo CLI, recovery bench, planner probe) needs."""
+    accelerator.  Returns ``(ok, detail)`` — detail is the backend summary
+    on success, the failure cause otherwise (bench stamps it into its JSON
+    line as degradation provenance).  The one shared implementation of the
+    probe-then-degrade block every offline entry point (undo CLI, recovery
+    bench, planner probe, bench.py) needs.  The default budget allows for
+    the probe's compile round-trip over the remote-dispatch link, not just
+    enumeration — a healthy-but-slow link must not get falsely pinned to
+    CPU mid-incident."""
     ok, detail, _ = probe_backend(timeout_sec=timeout_sec)
     if not ok:
         try:
@@ -154,4 +185,4 @@ def ensure_backend_or_cpu(tag: str, timeout_sec: float = 90.0) -> bool:
             pass  # backend already initialized: nothing left to force
         print(f"[{tag}] accelerator unreachable ({detail}); "
               f"degrading to the CPU path", file=sys.stderr, flush=True)
-    return ok
+    return ok, detail

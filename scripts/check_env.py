@@ -57,7 +57,7 @@ def _jax_backend():
     # that hangs is worse than a failing check).
     from nerrf_tpu.utils import probe_backend
 
-    ok, detail, _ = probe_backend(timeout_sec=75)
+    ok, detail, _ = probe_backend(timeout_sec=120)
     if not ok:
         raise RuntimeError(
             f"{detail} — CPU fallback: "

@@ -17,9 +17,21 @@
 cd "$(dirname "$0")/.."
 log() { echo "[queue $(date +%H:%M:%S)] $*" >> /tmp/tpu_queue.log; }
 log "watcher started (r3)"
+# the gate must exercise the full enumerate->compile->execute path: the
+# relay has been seen half-up (enumeration answering, remote_compile
+# refusing), which passes an enumeration-only check and then wedges the
+# first real step for half an hour.  One definition of reachable:
+# probe_backend (fresh uncached compile, process-group kill on timeout —
+# a bare `timeout` TERMs only the direct child and leaves runtime helper
+# processes holding the tunnel).
 while true; do
-  if timeout 90 python -c "import jax; assert jax.default_backend()=='tpu'" 2>/dev/null; then
-    log "TPU is back"; break
+  if python -c "
+import sys
+from nerrf_tpu.utils import probe_backend
+ok, detail, _ = probe_backend(timeout_sec=150)
+sys.exit(0 if ok and detail.startswith('tpu') else 1)
+" 2>/dev/null; then
+    log "TPU is back (fresh compile path verified)"; break
   fi
   sleep 120
 done

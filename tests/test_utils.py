@@ -39,3 +39,45 @@ def test_probe_child_failure_reports_stderr_tail():
 def test_probe_child_success_without_marker_is_failure():
     ok, detail, count = probe_backend(timeout_sec=30, _code="print('hi')")
     assert not ok and count == 0
+
+
+def test_probe_default_code_compiles_a_jitted_op():
+    # The default probe program must exercise the full
+    # enumerate->compile->execute path: the axon relay has been observed
+    # half-up (enumeration answering while remote_compile refused), which
+    # an enumeration-only probe reports as healthy right before the first
+    # real compile wedges for half an hour.  The platform pin goes through
+    # probe_backend's own parameter: JAX_PLATFORMS in the child's env is
+    # overridden by the accelerator plugin's interpreter-start registration,
+    # so only an in-process jax.config.update pins reliably.
+    ok, detail, count = probe_backend(timeout_sec=120, platform="cpu")
+    assert ok and count >= 1
+    assert "cpu" in detail
+
+
+def test_ensure_backend_or_cpu_returns_ok_and_detail(monkeypatch):
+    # bench.py stamps the failure detail into its JSON line as degradation
+    # provenance, so the helper must surface (ok, detail) — and force the
+    # CPU platform on failure so the caller's next jax op cannot hang.
+    import jax
+
+    import nerrf_tpu.utils as utils
+
+    monkeypatch.setattr(
+        utils, "probe_backend",
+        lambda timeout_sec=0: (False, "tunnel down (test)", 0))
+    # the failure branch pins jax_platforms to cpu in-process (by design);
+    # restore afterwards so this test cannot silently strip device-path
+    # coverage from the rest of the session on an accelerator-attached host
+    orig_platforms = jax.config.jax_platforms
+    try:
+        ok, detail = utils.ensure_backend_or_cpu("test", timeout_sec=1)
+    finally:
+        jax.config.update("jax_platforms", orig_platforms)
+    assert not ok and detail == "tunnel down (test)"
+
+    monkeypatch.setattr(
+        utils, "probe_backend",
+        lambda timeout_sec=0: (True, "tpu x1 (TPU v5 lite)", 1))
+    ok, detail = utils.ensure_backend_or_cpu("test", timeout_sec=1)
+    assert ok and detail == "tpu x1 (TPU v5 lite)"
