@@ -367,4 +367,21 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except SystemExit:
+        raise
+    except BaseException as e:  # noqa: BLE001 — the line contract is absolute
+        # "The bench must print its one JSON line either way": a fault in
+        # the measurement itself (missing dataset after a container reset,
+        # an OOM leg, a mid-run tunnel death) must still leave a line for
+        # the driver rather than a bare traceback.
+        print(json.dumps({
+            "metric": "nerrfnet_train_steps_per_sec",
+            "value": None,
+            "unit": "steps/s",
+            "vs_baseline": None,
+            "error": f"bench faulted before emitting its line: "
+                     f"{type(e).__name__}: {e}",
+        }))
+        sys.exit(1)

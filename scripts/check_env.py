@@ -54,13 +54,16 @@ def _module(mod):
 def _jax_backend():
     # Probe in a bounded subprocess (shared helper — a dead accelerator
     # tunnel makes jax.devices() block forever in-process, and a doctor
-    # that hangs is worse than a failing check).
-    from nerrf_tpu.utils import probe_backend
+    # that hangs is worse than a failing check).  The classifier separates
+    # "relay process dead" from "relay alive but its compile service is
+    # not" (the half-up state where enumeration answers and the first
+    # workload compile wedges) — different operator actions.
+    from nerrf_tpu.utils import classify_backend_state
 
-    ok, detail, _ = probe_backend(timeout_sec=120)
-    if not ok:
+    state, detail = classify_backend_state(timeout_sec=150)
+    if state != "healthy":
         raise RuntimeError(
-            f"{detail} — CPU fallback: "
+            f"accelerator {state}: {detail} — CPU fallback: "
             "jax.config.update('jax_platforms', 'cpu')")
     return detail
 

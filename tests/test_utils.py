@@ -81,3 +81,40 @@ def test_ensure_backend_or_cpu_returns_ok_and_detail(monkeypatch):
         lambda timeout_sec=0: (True, "tpu x1 (TPU v5 lite)", 1))
     ok, detail = utils.ensure_backend_or_cpu("test", timeout_sec=1)
     assert ok and detail == "tpu x1 (TPU v5 lite)"
+
+
+def test_classify_backend_state_three_states(monkeypatch):
+    # The doctor separates "relay process dead" from "relay alive but its
+    # compile service is not": the half-up relay issues device handles and
+    # then wedges the first workload compile, so the two failures need
+    # different operator responses.
+    import nerrf_tpu.utils as utils
+
+    def fake_probe(states):
+        calls = iter(states)
+
+        def probe(timeout_sec=0, _code=None):
+            ok, detail = next(calls)
+            # the second (classification) probe must be enumeration-only
+            if _code is not None:
+                assert "jit" not in _code
+            return ok, detail, 1 if ok else 0
+        return probe
+
+    monkeypatch.setattr(utils, "probe_backend",
+                        fake_probe([(True, "tpu x1 (TPU v5 lite)")]))
+    state, detail = utils.classify_backend_state(timeout_sec=1)
+    assert state == "healthy" and "tpu" in detail
+
+    monkeypatch.setattr(utils, "probe_backend",
+                        fake_probe([(False, "did not respond in 1s"),
+                                    (True, "tpu x1 (TPU v5 lite)")]))
+    state, detail = utils.classify_backend_state(timeout_sec=1)
+    assert state == "half-up"
+    assert "enumeration answers" in detail and "did not respond" in detail
+
+    monkeypatch.setattr(utils, "probe_backend",
+                        fake_probe([(False, "did not respond in 1s"),
+                                    (False, "did not respond in 1s")]))
+    state, detail = utils.classify_backend_state(timeout_sec=1)
+    assert state == "down" and "did not respond" in detail
