@@ -277,42 +277,87 @@ def main() -> None:
     # minutes — they run via their own scripts and check their reports in;
     # the bench surfaces the headline numbers with provenance)
     artifacts = {}
-    try:
-        art_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                               "benchmarks", "results")
-        j100 = next((p for p in (
+    art_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "benchmarks", "results")
+
+    def _j100():
+        # newest round first
+        p = next((q for q in (
             os.path.join(art_dir, f"joint100h_r{n}.json")
-            for n in (4, 3, 2)) if os.path.exists(p)), "")
-        if j100:
-            r = json.load(open(j100))
-            artifacts["corpus100h"] = {
-                "hours": r.get("corpus_hours"),
-                "edge_auc": r.get("metrics", {}).get("edge_auc"),
-                "seq_f1": r.get("metrics", {}).get("seq_f1"),
-                "steps_per_sec": r.get("steps_per_sec"),
-                "provenance": "python -m nerrf_tpu.train.run "
-                              "--experiment joint-100h",
-            }
+            for n in (4, 3, 2)) if os.path.exists(q)), "")
+        if not p:
+            return None
+        r = json.load(open(p))
+        return {
+            "hours": r.get("corpus_hours"),
+            "edge_auc": r.get("metrics", {}).get("edge_auc"),
+            "seq_f1": r.get("metrics", {}).get("seq_f1"),
+            "steps_per_sec": r.get("steps_per_sec"),
+            "provenance": "python -m nerrf_tpu.train.run "
+                          "--experiment joint-100h",
+        }
+
+    def _adv():
         # preference: newest chip artifact, then the CPU probe artifact
         # (current code, small model), then older chip/CPU rounds — the r2
         # file predates the mutation gate + hardened corpus and would
         # misreport the current system
-        adv = next((p for p in (
+        p = next((q for q in (
             os.path.join(art_dir, name)
             for name in ("adversarial_r4.json", "adversarial_r3.json",
                          "adversarial_probe_cpu.json", "adversarial_r2.json"))
-            if os.path.exists(p)), "")
-        if adv:
-            r = json.load(open(adv))
-            artifacts["adversarial"] = {
-                "fp_undo_rate_worst": r.get("kpi", {}).get(
-                    "fp_undo_rate_worst_model"),
-                "fp_undo_met": r.get("kpi", {}).get("fp_undo_met"),
-                "source": os.path.basename(adv),
-                "provenance": "python benchmarks/run_adversarial_eval.py",
-            }
-    except Exception as e:
-        log(f"[bench] artifact surfacing failed: {e!r}")
+            if os.path.exists(q)), "")
+        if not p:
+            return None
+        r = json.load(open(p))
+        return {
+            "fp_undo_rate_worst": r.get("kpi", {}).get(
+                "fp_undo_rate_worst_model"),
+            "fp_undo_met": r.get("kpi", {}).get("fp_undo_met"),
+            "source": os.path.basename(p),
+            "provenance": "python benchmarks/run_adversarial_eval.py",
+        }
+
+    def _recovery():
+        p = os.path.join(art_dir, "m1_recovery.json")
+        if not os.path.exists(p):
+            return None
+        r = json.load(open(p))
+        return {
+            "mttr_seconds": r.get("kpis", {}).get("mttr_seconds"),
+            "data_loss_bytes": r.get("kpis", {}).get("data_loss_bytes"),
+            "false_positive_undos":
+                r.get("kpis", {}).get("false_positive_undos"),
+            "backend": r.get("backend"),
+            "provenance": "python benchmarks/run_recovery_bench.py "
+                          "--scale m1",
+        }
+
+    def _tracker():
+        p = os.path.join(art_dir, "tracker_perf.json")
+        if not os.path.exists(p):
+            return None
+        r = json.load(open(p))
+        return {
+            "events_per_sec_sustained":
+                r.get("paced", {}).get("events_per_sec_sustained"),
+            "p50_latency_us":
+                r.get("paced", {}).get("delivery_latency_us", {}).get("p50"),
+            "flood_events_per_sec":
+                r.get("flood", {}).get("events_per_sec_sustained"),
+            "provenance": "python benchmarks/run_tracker_bench.py",
+        }
+
+    # per-artifact isolation: one truncated/corrupt JSON on disk must not
+    # silently drop the valid artifacts after it
+    for key, loader in (("corpus100h", _j100), ("adversarial", _adv),
+                        ("m1_recovery", _recovery), ("tracker", _tracker)):
+        try:
+            entry = loader()
+            if entry is not None:
+                artifacts[key] = entry
+        except Exception as e:
+            log(f"[bench] artifact surfacing for {key} failed: {e!r}")
 
     try:
         from nerrf_tpu.ops.segment import active_impls

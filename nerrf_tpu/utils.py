@@ -123,7 +123,12 @@ def classify_backend_state(
     if ok:
         return "healthy", detail
     full_failure = detail
-    ok, detail, _ = probe_backend(timeout_sec=timeout_sec,
+    # the classification probe gets a short budget: enumeration on a live
+    # relay answers in seconds (half-up is *defined* by enumeration
+    # answering while compile does not), so a fully-dead link costs
+    # timeout + ~30s, not 2x timeout, during the exact incident the
+    # doctor exists for
+    ok, detail, _ = probe_backend(timeout_sec=min(timeout_sec, 30.0),
                                   _code=_ENUM_ONLY_CODE)
     if ok:
         # NOTE deliberately hedged: a genuinely half-up relay and a
