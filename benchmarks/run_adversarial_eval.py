@@ -16,7 +16,9 @@ For each scenario × {heuristic, model} detector:
   * window-level edge ROC-AUC / seq F1 (where the scenario has positives)
   * file-level product metrics: detection rate over actually-encrypted
     files, and the FP-undo rate = benign files among all files the pipeline
-    would roll back (the KPI; measured at the pipeline's 0.5 threshold)
+    would roll back (the KPI; measured at the pipeline's operating
+    threshold — the checkpoint's held-out-calibrated node_threshold when
+    one exists, the historical 0.5 otherwise; reported as node_threshold)
 
 Usage:
   python benchmarks/run_adversarial_eval.py --out benchmarks/results/adversarial.json
@@ -59,32 +61,11 @@ def _scenario_traces(scenario: str, n: int, seed: int):
 
 
 def _attacked_files(trace) -> tuple[set, set]:
-    """File-granular ground truth from per-event labels:
-    (encrypted, attack_touched) — `encrypted` are the ransom-renamed
-    victims (detection-rate denominator); `attack_touched` additionally
-    includes every path an attack event wrote/renamed (ransom note, the
-    pre-rename names), so flagging those does not count as a false undo."""
-    from nerrf_tpu.schema.events import MUTATING_SYSCALLS
+    """(encrypted, attack_touched) ground truth — shared with threshold
+    calibration via pipeline.attack_touched_files (one label derivation)."""
+    from nerrf_tpu.pipeline import attack_touched_files
 
-    ev, st = trace.events, trace.strings
-    encrypted, touched = set(), set()
-    if trace.labels is None:
-        return encrypted, touched
-    mutating = MUTATING_SYSCALLS
-    for i in range(len(ev)):
-        if not ev.valid[i] or trace.labels[i] < 0.5:
-            continue
-        path = st.lookup(int(ev.path_id[i]))
-        new = st.lookup(int(ev.new_path_id[i]))
-        if new.endswith(".lockbit3"):
-            encrypted.add(new)
-        # only MUTATED paths excuse an undo — attack reads (recon of
-        # /etc/passwd etc.) must still count as FP if reverted
-        if int(ev.syscall[i]) in mutating:
-            for p in (path, new):
-                if p:
-                    touched.add(p)
-    return encrypted, touched
+    return attack_touched_files(trace)
 
 
 def _benign_touched_files(trace) -> set:
@@ -114,7 +95,10 @@ def _file_metrics(items, detect) -> dict:
     for item in items:
         tr = item[0]
         det = detect(item)
-        flagged = set(det.flagged_files(0.5))
+        # the detection's own operating point: the checkpoint's held-out
+        # calibrated threshold when one exists, 0.5 otherwise — measuring a
+        # calibrated model at someone else's cut misreports its FP behavior
+        flagged = set(det.flagged_files())
         encrypted, touched = _attacked_files(tr)
         attacked_total += len(encrypted)
         flagged_total += len(flagged)
@@ -163,11 +147,12 @@ def main(argv=None) -> int:
     _log(f"backend={backend}")
 
     if args.model_dir:
-        from nerrf_tpu.train.checkpoint import load_checkpoint
+        from nerrf_tpu.train.checkpoint import load_calibration, load_checkpoint
 
         params, model_cfg = load_checkpoint(args.model_dir)
         model = NerrfNet(model_cfg)
         trained_on = f"checkpoint:{args.model_dir}"
+        node_threshold = load_calibration(args.model_dir).get("node_threshold")
     else:
         corpus = make_corpus(12, attack_fraction=0.5, base_seed=args.seed,
                              duration_sec=180.0, num_target_files=24,
@@ -177,9 +162,16 @@ def main(argv=None) -> int:
         res = train_nerrfnet(build_dataset(corpus), cfg=cfg, log=_log)
         params, model = res.state.params, NerrfNet(cfg.model)
         trained_on = f"fresh standard corpus ({args.train_steps} steps)"
-    eval_fn = make_eval_fn(model)
+        from nerrf_tpu.pipeline import calibrate_file_threshold
 
-    report = {"backend": backend, "trained_on": trained_on, "scenarios": {}}
+        cal = calibrate_file_threshold(params, model, log=_log)
+        node_threshold = cal[0] if cal else None
+    eval_fn = make_eval_fn(model)
+    _log(f"file-detector operating threshold: "
+         f"{node_threshold if node_threshold is not None else '0.5 (default)'}")
+
+    report = {"backend": backend, "trained_on": trained_on,
+              "node_threshold": node_threshold, "scenarios": {}}
     worst_fp = 0.0
     for scenario in SCENARIOS:
         _log(f"scenario {scenario}…")
@@ -197,7 +189,9 @@ def main(argv=None) -> int:
             entry["seq_f1"] = round(m["seq_f1"], 4)
         # one model pass per trace; both aggregation rules derived from the
         # cached per-window scores (pipeline.DetectionResult.rescored)
-        detections = [model_detect(tr, params, model) for tr in traces]
+        detections = [model_detect(tr, params, model,
+                                   threshold=node_threshold)
+                      for tr in traces]
         entry["model"] = _file_metrics(
             list(zip(traces, detections)), lambda td: td[1])
         entry["model_robust"] = _file_metrics(

@@ -104,6 +104,24 @@ def cmd_train_detector(args) -> int:
          f"seq_f1={res.metrics['seq_f1']:.4f} ({res.steps_per_sec:.1f} steps/s)")
     save_checkpoint(args.model_dir, res.state.params, model_cfg)
     _log(f"checkpoint saved to {args.model_dir}")
+    # calibrate the file-detector operating point and re-save the sidecar:
+    # an uncalibrated checkpoint operates `nerrf undo` at the 0.5 cut that
+    # measurably flags benign rotated logs (p≈0.80) — see
+    # pipeline.calibrate_file_threshold.  Best-effort: the weights above
+    # are already safe on disk.
+    try:
+        from nerrf_tpu.models import NerrfNet
+        from nerrf_tpu.pipeline import calibrate_file_threshold
+
+        cal = calibrate_file_threshold(res.state.params, NerrfNet(model_cfg),
+                                       log=_log)
+        if cal is not None:
+            save_checkpoint(args.model_dir, res.state.params, model_cfg,
+                            calibration={"node_threshold": round(cal[0], 4),
+                                         "node_threshold_kind": cal[1]})
+    except Exception as e:  # noqa: BLE001 — checkpoint already safe
+        _log(f"calibration failed ({type(e).__name__}: {e}); "
+             "checkpoint keeps the 0.5 default threshold")
     return 0 if res.metrics["edge_auc"] >= 0.9 else 1
 
 
@@ -118,9 +136,14 @@ def cmd_undo(args) -> int:
     # link is dead: establish reachability in a bounded probe and force the
     # CPU backend if it fails — the first in-process jax op would otherwise
     # block forever on a wedged tunnel (observed with the axon relay).
-    # Bounded cost on a healthy host; skip with --no-probe.
+    # The budget is deliberately SHORTER than the offline benches' 150 s:
+    # the probe wait lands directly in the operator's MTTR, and at incident
+    # scale the CPU planner is only ~1-2 s slower than the device one
+    # (m1_recovery.json: plan 2.3 s on CPU), so waiting longer than ~75 s
+    # for a flaky chip can never pay for itself; a healthy link probes in
+    # ~30-45 s (init + tiny compile round-trip).  Skip with --no-probe.
     if not getattr(args, "no_probe", False):
-        ensure_backend_or_cpu("nerrf", timeout_sec=120.0)
+        ensure_backend_or_cpu("nerrf", timeout_sec=75.0)
     from nerrf_tpu.data.loaders import load_trace_jsonl
     from nerrf_tpu.pipeline import build_undo_domain, heuristic_detect, model_detect
     from nerrf_tpu.planner import MCTSConfig, make_planner
@@ -139,10 +162,12 @@ def cmd_undo(args) -> int:
     # --- detect -------------------------------------------------------------
     if args.model_dir:
         from nerrf_tpu.models import NerrfNet
-        from nerrf_tpu.train.checkpoint import load_checkpoint
+        from nerrf_tpu.train.checkpoint import load_calibration, load_checkpoint
 
         params, model_cfg = load_checkpoint(args.model_dir)
-        detection = model_detect(trace, params, NerrfNet(model_cfg))
+        calib = load_calibration(args.model_dir)
+        detection = model_detect(trace, params, NerrfNet(model_cfg),
+                                 threshold=calib.get("node_threshold"))
     else:
         detection = heuristic_detect(trace)
     flagged = detection.flagged_files()

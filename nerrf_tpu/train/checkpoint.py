@@ -19,7 +19,8 @@ import orbax.checkpoint as ocp
 from nerrf_tpu.models import GraphSAGEConfig, JointConfig, LSTMConfig
 
 
-def save_checkpoint(path: str | Path, params, cfg: JointConfig) -> None:
+def save_checkpoint(path: str | Path, params, cfg: JointConfig,
+                    calibration: dict | None = None) -> None:
     path = Path(path).absolute()
     path.mkdir(parents=True, exist_ok=True)
     with ocp.StandardCheckpointer() as ckptr:
@@ -31,6 +32,12 @@ def save_checkpoint(path: str | Path, params, cfg: JointConfig) -> None:
                  "dropout": cfg.lstm.dropout},
         "fuse": cfg.fuse,
     }
+    if calibration:
+        # held-out-calibrated operating points (e.g. node_threshold: the
+        # probability cut the file-level detector should flag at) — they
+        # belong WITH the weights: a checkpoint evaluated at someone else's
+        # threshold silently changes its false-positive behavior
+        meta["calibration"] = calibration
     (path / "model_config.json").write_text(json.dumps(meta, indent=2))
 
 
@@ -45,3 +52,12 @@ def load_checkpoint(path: str | Path) -> Tuple[dict, JointConfig]:
     with ocp.StandardCheckpointer() as ckptr:
         params = ckptr.restore(path / "params")
     return params, cfg
+
+
+def load_calibration(path: str | Path) -> dict:
+    """The checkpoint's held-out-calibrated operating points ({} when the
+    checkpoint predates calibration).  Separate from load_checkpoint so its
+    two-tuple contract stays stable for existing callers."""
+    meta = json.loads((Path(path).absolute() / "model_config.json")
+                      .read_text())
+    return meta.get("calibration") or {}

@@ -344,12 +344,21 @@ def evaluate(eval_fn, params, ds: WindowDataset, batch_size: int = 8,
     n_s, n_l = np.concatenate(node_scores), np.concatenate(node_labels)
     s_s, s_l = np.concatenate(seq_scores), np.concatenate(seq_labels)
     seq_f1, seq_t = best_f1(s_l, s_s)
+    node_f1, _node_t = best_f1(n_l, n_s)
+    # NOTE: no node-level operating threshold is derived here — the file
+    # detector's threshold is calibrated at FILE granularity through the
+    # deployed decision function (pipeline.calibrate_file_threshold):
+    # node-level precision is dominated by the abundant easy positives and
+    # calibrates to a uselessly low cut (measured p≈0.04), while the KPI
+    # failure mode lives in per-file max-aggregation over few hard
+    # negatives.
     return {
         "edge_auc": roc_auc(e_l, e_s),
         "node_auc": roc_auc(n_l, n_s),
         "seq_auc": roc_auc(s_l, s_s),
         "seq_f1": seq_f1,
         "seq_f1_threshold": seq_t,
+        "node_f1": node_f1,
         "num_edges_eval": float(len(e_l)),
         "num_seqs_eval": float(len(s_l)),
     }

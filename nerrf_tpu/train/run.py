@@ -171,7 +171,34 @@ def _finish(exp, cfg, out: Path, n_dev, metrics, steps_per_sec, params,
 
     from nerrf_tpu.train.checkpoint import save_checkpoint
 
+    # weights FIRST: calibration below is best-effort post-processing and
+    # must never be able to lose a finished training run
     save_checkpoint(out / "model", params, cfg.model)
+    calibration = None
+    if cfg.node_loss_weight > 0 and jax.process_count() == 1:
+        # the held-out-calibrated file-detector operating point travels
+        # with the weights (see checkpoint.save_checkpoint); calibrated at
+        # file granularity through the deployed decision function — only
+        # meaningful when this experiment trained the node head.  Guarded
+        # to single-controller runs: model_detect pulls scores to host
+        # numpy, which multi-host sharded params don't support (and every
+        # process recomputing 4 incidents would be waste).
+        from nerrf_tpu.models import NerrfNet
+        from nerrf_tpu.pipeline import calibrate_file_threshold
+
+        try:
+            cal = calibrate_file_threshold(params, NerrfNet(cfg.model),
+                                           log=_log)
+        except Exception as e:  # noqa: BLE001 — checkpoint already safe
+            _log(f"calibration failed ({type(e).__name__}: {e}); "
+                 "checkpoint keeps the 0.5 default threshold")
+            cal = None
+        if cal is not None:
+            t, kind = cal
+            calibration = {"node_threshold": round(t, 4),
+                           "node_threshold_kind": kind}
+            save_checkpoint(out / "model", params, cfg.model,
+                            calibration=calibration)
     report = {
         "experiment": exp.name,
         "backend": jax.default_backend(),
@@ -179,6 +206,7 @@ def _finish(exp, cfg, out: Path, n_dev, metrics, steps_per_sec, params,
         "num_steps": cfg.num_steps,
         "steps_per_sec": round(steps_per_sec, 3),
         "metrics": {k: round(float(v), 4) for k, v in metrics.items()},
+        "calibration": calibration,
         # A head's gate only applies when the experiment trains that head:
         # lstm-impact runs with edge/node weights 0 and toy-graphsage with
         # seq weight 0 — an untrained head's gate could never pass and would

@@ -97,7 +97,69 @@ def test_train_end_to_end_quality_gate():
     assert m["edge_auc"] >= 0.90, m
     assert m["seq_auc"] >= 0.90, m
     assert m["seq_f1"] >= 0.95, m
+    assert m["node_f1"] >= 0.90, m
     assert result.steps_per_sec > 0.5
+
+
+def test_threshold_at_precision():
+    """KPI-aligned calibrator: max recall subject to a precision floor,
+    cut centered in the local score gap (not on a cluster edge)."""
+    import numpy as np
+
+    from nerrf_tpu.train.metrics import threshold_at_precision
+
+    # 6 positives at 0.99, a dense benign cluster at ~0.80, rest at ~0.1
+    labels = np.array([1] * 6 + [0] * 6 + [0] * 10)
+    scores = np.array([0.99] * 6 + [0.80, 0.801, 0.802, 0.803, 0.80, 0.799]
+                      + [0.1] * 10)
+    t = threshold_at_precision(labels, scores, target=0.98)
+    # only the positives may flag: the cut must sit between the benign
+    # cluster top (0.803) and the positive cluster (0.99) — centered
+    assert 0.803 < t < 0.99
+    assert t == (0.99 + 0.803) / 2
+
+    # unreachable floor (positives fully under the negatives) → None
+    assert threshold_at_precision(
+        np.array([1, 0]), np.array([0.2, 0.9]), target=0.98) is None
+
+    # degenerate: no positives → None
+    assert threshold_at_precision(
+        np.array([0, 0]), np.array([0.2, 0.9])) is None
+
+
+def test_checkpoint_calibration_roundtrip(tmp_path):
+    """The held-out-calibrated operating point travels with the weights and
+    reaches the detector: save → load_calibration → DetectionResult
+    threshold semantics (a checkpoint predating calibration yields {})."""
+    import numpy as np
+
+    from nerrf_tpu.config import JointConfig  # noqa: F401 (re-export check)
+    from nerrf_tpu.models import GraphSAGEConfig, LSTMConfig
+    from nerrf_tpu.models import JointConfig as JC
+    from nerrf_tpu.pipeline import DetectionResult
+    from nerrf_tpu.train.checkpoint import (
+        load_calibration,
+        load_checkpoint,
+        save_checkpoint,
+    )
+
+    cfg = JC(gnn=GraphSAGEConfig(hidden=8, num_layers=1),
+             lstm=LSTMConfig(hidden=8, num_layers=1))
+    params = {"w": np.ones((2, 2), np.float32)}
+    save_checkpoint(tmp_path / "m", params, cfg,
+                    calibration={"node_threshold": 0.9})
+    assert load_calibration(tmp_path / "m") == {"node_threshold": 0.9}
+    p2, cfg2 = load_checkpoint(tmp_path / "m")
+    assert cfg2.gnn.hidden == 8
+
+    save_checkpoint(tmp_path / "m0", params, cfg)
+    assert load_calibration(tmp_path / "m0") == {}
+
+    # threshold semantics: the result's own operating point gates
+    # flagged_files; an explicit argument still overrides
+    det = DetectionResult({"/a": 0.95, "/b": 0.8}, {}, {}, threshold=0.9)
+    assert set(det.flagged_files()) == {"/a"}
+    assert set(det.flagged_files(0.5)) == {"/a", "/b"}
 
 
 def test_evaluate_resident_matches_host_slicing(small_dataset):
