@@ -314,6 +314,7 @@ def main() -> None:
             "fp_undo_rate_worst": r.get("kpi", {}).get(
                 "fp_undo_rate_worst_model"),
             "fp_undo_met": r.get("kpi", {}).get("fp_undo_met"),
+            "node_threshold": r.get("node_threshold"),
             "source": os.path.basename(p),
             "provenance": "python benchmarks/run_adversarial_eval.py",
         }
