@@ -24,17 +24,19 @@ log "watcher started (r3)"
 # probe_backend (fresh uncached compile, process-group kill on timeout —
 # a bare `timeout` TERMs only the direct child and leaves runtime helper
 # processes holding the tunnel).
-while true; do
-  if python -c "
+tpu_ok() {
+  python -c "
 import sys
 from nerrf_tpu.utils import probe_backend
 ok, detail, _ = probe_backend(timeout_sec=150)
 sys.exit(0 if ok and detail.startswith('tpu') else 1)
-" 2>/dev/null; then
-    log "TPU is back (fresh compile path verified)"; break
-  fi
-  sleep 120
-done
+" 2>/dev/null
+}
+wait_for_tpu() {
+  while ! tpu_ok; do sleep 120; done
+  log "TPU is up (fresh compile path verified)"
+}
+wait_for_tpu
 # require the REGENERATED corpus (auto-fit capacities + zero-drop proof in
 # the manifest) — training on the r2 truncated corpus would repeat weak #3
 while ! python - <<'EOF' 2>/dev/null
@@ -49,8 +51,12 @@ done
 log "1/6 joint-100h training"
 # the corpus is ~10 GB and rotates shards through the chip each epoch; over
 # a ~0.5 GB/s tunnel the wall clock is transfer-bound, so budget generously
-# and rely on resume-from-checkpoint for the retry
-for attempt in 1 2; do
+# and rely on resume-from-checkpoint for the retry.  The tunnel has twice
+# come up for only minutes and died: re-verify it before EVERY attempt so a
+# flap doesn't burn a 2 h timeout against a dead link — a failed attempt
+# goes back to waiting, not straight into the next attempt.
+for attempt in 1 2 3; do
+  wait_for_tpu
   timeout 7200 python -m nerrf_tpu.train.run --experiment joint-100h \
     --out runs/joint-100h-r3 --ckpt-every 2000 > /tmp/joint100.log 2>&1
   rc=$?
