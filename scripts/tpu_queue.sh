@@ -99,4 +99,9 @@ log "6b: chip-gated compiled-kernel test"
 NERRF_TEST_REAL_BACKEND=1 timeout 1200 python -m pytest \
   tests/test_pallas_ops.py -q -k compiled_on_tpu > /tmp/pallas_tpu.log 2>&1
 log "pallas chip test rc=$?"
+log "6c: stream detector quality on chip"
+timeout 1800 python benchmarks/run_stream_eval.py --steps 600 \
+  --train-traces 14 \
+  --out benchmarks/results/stream_probe_tpu.json > /tmp/stream_tpu.log 2>&1
+log "stream quality rc=$?"
 log "queue done"
