@@ -41,14 +41,20 @@ def _log(msg):
 def _traces(n, base_seed, duration_sec, files, rate):
     from nerrf_tpu.data.synth import SimConfig, simulate_trace
 
-    scenarios = ("standard", "slow-drip", "multi-process", "benign-comm")
+    atk_scenarios = ("standard", "slow-drip", "multi-process", "benign-comm")
+    # benign traces alternate plain background with the bulk-rename job —
+    # rename-shaped benign activity is the hard negative that trips
+    # rename-keyed detectors, and a stream AUC that never saw it would
+    # overstate robustness
+    ben_scenarios = ("standard", "benign-mass-rename")
     out = []
     for i in range(n):
         attack = i % 2 == 0
-        # attack traces are the EVEN i, so index the rotation by i//2 —
+        # attack traces are the EVEN i, so index each rotation by i//2 —
         # `i % len` would only ever reach the even-indexed scenarios and
         # silently skip the stealth ones (slow-drip, benign-comm)
-        scenario = scenarios[(i // 2) % len(scenarios)] if attack else "standard"
+        scenario = (atk_scenarios[(i // 2) % len(atk_scenarios)] if attack
+                    else ben_scenarios[(i // 2) % len(ben_scenarios)])
         out.append(simulate_trace(SimConfig(
             duration_sec=duration_sec, num_target_files=files,
             benign_rate_hz=rate, attack=attack, scenario=scenario,
