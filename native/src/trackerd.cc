@@ -17,7 +17,15 @@
 //
 // Usage: nerrf-trackerd [--listen HOST:PORT] [--batch N] [--ringbuf BYTES]
 //                       [--max-seconds S] [--capture-self] [--probe]
+//                       [--synthetic HZ]
 //   TRACKER_LISTEN_ADDR honored like the reference (main.go:113).
+//
+// --synthetic HZ serves a fabricated openat→write→rename workload at ~HZ
+// events/s through the full encode→batch→broadcast→HTTP/2 path with NO
+// kernel capture: the interop surface (hand-rolled h2grpc.cc vs stock gRPC
+// clients) becomes testable on hosts without BPF permission, exactly like
+// the reference exercises its daemon with grpcurl
+// (`tracker/scripts/test.sh:76-82`).
 
 #include <signal.h>
 #include <stdio.h>
@@ -201,6 +209,7 @@ int main(int argc, char **argv) {
   int max_seconds = 0;
   bool capture_self = false;
   bool probe_only = false;
+  int synthetic_hz = 0;
 
   for (int i = 1; i < argc; ++i) {
     std::string a = argv[i];
@@ -213,30 +222,37 @@ int main(int argc, char **argv) {
     else if (a == "--max-seconds") max_seconds = atoi(next());
     else if (a == "--capture-self") capture_self = true;
     else if (a == "--probe") probe_only = true;
+    else if (a == "--synthetic") synthetic_hz = atoi(next());
     else {
       fprintf(stderr, "usage: %s [--listen H:P] [--ringbuf B] [--batch N] "
-                      "[--max-seconds S] [--capture-self] [--probe]\n",
+                      "[--max-seconds S] [--capture-self] [--probe] "
+                      "[--synthetic HZ]\n",
               argv[0]);
       return 1;
     }
   }
 
   char err[1024] = {0};
-  int st = nerrf_capture_probe(err, sizeof(err));
-  if (st != NERRF_CAPTURE_OK) {
-    fprintf(stderr, "[trackerd] capture unavailable: %s\n", err);
-    return st == NERRF_CAPTURE_EPERM ? 2 : 3;
-  }
-  if (probe_only) {
-    printf("capture ok\n");
+  nerrf_capture *cap = nullptr;
+  if (synthetic_hz <= 0) {
+    int st = nerrf_capture_probe(err, sizeof(err));
+    if (st != NERRF_CAPTURE_OK) {
+      fprintf(stderr, "[trackerd] capture unavailable: %s\n", err);
+      return st == NERRF_CAPTURE_EPERM ? 2 : 3;
+    }
+    if (probe_only) {
+      printf("capture ok\n");
+      return 0;
+    }
+    cap = nerrf_capture_open(
+        ringbuf_bytes, capture_self ? 0 : getpid(), err, sizeof(err));
+    if (!cap) {
+      fprintf(stderr, "[trackerd] capture open failed: %s\n", err);
+      return 3;
+    }
+  } else if (probe_only) {
+    printf("synthetic ok\n");
     return 0;
-  }
-
-  nerrf_capture *cap = nerrf_capture_open(
-      ringbuf_bytes, capture_self ? 0 : getpid(), err, sizeof(err));
-  if (!cap) {
-    fprintf(stderr, "[trackerd] capture open failed: %s\n", err);
-    return 3;
   }
 
   Broadcaster bcast;
@@ -244,7 +260,7 @@ int main(int argc, char **argv) {
   nerrf::GrpcStreamServer server(listen, "/nerrf.trace.Tracker/StreamEvents");
   server.set_subscribe([&] { return bcast.subscribe(); });
   server.set_on_peer([&](int pid) {
-    if (pid > 0) nerrf_capture_exclude_pid(cap, pid);
+    if (pid > 0 && cap) nerrf_capture_exclude_pid(cap, pid);
   });
   int port = server.start();
   if (port < 0) {
@@ -252,8 +268,10 @@ int main(int argc, char **argv) {
     nerrf_capture_close(cap);
     return 1;
   }
-  fprintf(stderr, "[trackerd] capturing; serving StreamEvents on %s\n",
-          listen.c_str());
+  // resolved port in the log line: clients of `--listen host:0` (tests
+  // avoiding fixed-port collisions) parse it from here
+  fprintf(stderr, "[trackerd] %s; serving StreamEvents on %s (port %d)\n",
+          cap ? "capturing" : "synthetic source", listen.c_str(), port);
   if (listen.rfind("unix:", 0) != 0)
     fprintf(stderr,
             "[trackerd] note: TCP clients cannot be pid-excluded "
@@ -275,8 +293,53 @@ int main(int argc, char **argv) {
 
   time_t start = time(nullptr);
   time_t last_log = start;
+  uint64_t synth_seq = 0;
   while (!g_stop.load()) {
-    nerrf_capture_poll(cap, 100, on_event, &cx);
+    if (cap) {
+      nerrf_capture_poll(cap, 100, on_event, &cx);
+    } else {
+      // synthetic workload: ~synthetic_hz events/s of the canonical
+      // openat→write→rename triple, through the SAME encode path live
+      // capture uses — only the event source differs
+      int burst = synthetic_hz / 20 + 1;  // 50 ms cadence
+      struct timespec now_mt;
+      for (int k = 0; k < burst; ++k) {
+        clock_gettime(CLOCK_MONOTONIC, &now_mt);
+        nerrf_event_record rec;
+        memset(&rec, 0, sizeof(rec));
+        rec.ts_ns = static_cast<uint64_t>(now_mt.tv_sec) * 1000000000ull +
+                    static_cast<uint64_t>(now_mt.tv_nsec);
+        rec.pid = 4242;
+        rec.tid = 4242;
+        snprintf(rec.comm, sizeof(rec.comm), "synthload");
+        uint64_t file = synth_seq / 3;
+        switch (synth_seq % 3) {
+          case 0:
+            rec.syscall_id = NERRF_SC_OPENAT;
+            snprintf(rec.path, sizeof(rec.path),
+                     "/app/uploads/doc_%llu.dat", (unsigned long long)file);
+            break;
+          case 1:
+            rec.syscall_id = NERRF_SC_WRITE;
+            rec.bytes = 4096;
+            snprintf(rec.path, sizeof(rec.path),
+                     "/app/uploads/doc_%llu.dat", (unsigned long long)file);
+            break;
+          default:
+            rec.syscall_id = NERRF_SC_RENAME;
+            snprintf(rec.path, sizeof(rec.path),
+                     "/app/uploads/doc_%llu.dat", (unsigned long long)file);
+            snprintf(rec.new_path, sizeof(rec.new_path),
+                     "/app/uploads/doc_%llu.dat.lockbit3",
+                     (unsigned long long)file);
+            break;
+        }
+        ++synth_seq;
+        on_event(&cx, &rec);
+      }
+      struct timespec nap = {0, 50 * 1000000};
+      nanosleep(&nap, nullptr);
+    }
     flush_batch(&cx);  // latency bound: ship partial batches every poll round
     time_t now = time(nullptr);
     if (max_seconds > 0 && now - start >= max_seconds) break;
@@ -286,7 +349,7 @@ int main(int argc, char **argv) {
               "dropped_frames=%llu subscribers=%llu\n",
               (unsigned long long)stats.events.load(),
               (unsigned long long)stats.frames.load(),
-              (unsigned long long)nerrf_capture_dropped(cap),
+              (unsigned long long)(cap ? nerrf_capture_dropped(cap) : 0),
               (unsigned long long)stats.frames_dropped.load(),
               (unsigned long long)server.subscribers());
       last_log = now;
@@ -295,9 +358,9 @@ int main(int argc, char **argv) {
 
   fprintf(stderr, "[trackerd] shutting down: events=%llu kernel_dropped=%llu\n",
           (unsigned long long)stats.events.load(),
-          (unsigned long long)nerrf_capture_dropped(cap));
+          (unsigned long long)(cap ? nerrf_capture_dropped(cap) : 0));
   bcast.close_all();
   server.stop();
-  nerrf_capture_close(cap);
+  if (cap) nerrf_capture_close(cap);
   return 0;
 }

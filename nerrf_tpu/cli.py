@@ -63,9 +63,13 @@ def cmd_simulate(args) -> int:
 
 # --------------------------------------------------------------------------
 def cmd_train_detector(args) -> int:
-    from nerrf_tpu.utils import enable_compilation_cache
+    from nerrf_tpu.utils import enable_compilation_cache, ensure_backend_or_cpu
 
     enable_compilation_cache()
+    # same probe-or-degrade guard as cmd_undo: an operator retraining the
+    # detector behind a wedged tunnel would otherwise hang on the first
+    # traced op (observed: dead axon relay wedges backend init at 0% CPU)
+    ensure_backend_or_cpu("nerrf-train", timeout_sec=75.0)
     from nerrf_tpu.data import make_corpus
     from nerrf_tpu.graph import GraphConfig
     from nerrf_tpu.models import GraphSAGEConfig, JointConfig, LSTMConfig
@@ -81,8 +85,11 @@ def cmd_train_detector(args) -> int:
     if args.traces < n_eval + 4:
         _log(f"--traces must be ≥ {n_eval + 4} (need {n_eval} eval + ≥4 train runs)")
         return 2
+    # hard-scenario mix: a deployed detector trained on rename-style attacks
+    # alone re-learns the heuristic's shortcut (data/synth.py ATTACK_VARIANTS)
     corpus = make_corpus(args.traces, attack_fraction=0.5, base_seed=args.seed,
-                         duration_sec=150.0, num_target_files=8, benign_rate_hz=25.0)
+                         duration_sec=150.0, num_target_files=8,
+                         benign_rate_hz=25.0, hard_scenarios=True)
     ds_cfg = DatasetConfig(graph=GraphConfig(max_nodes=256, max_edges=512),
                            seq_len=100, max_seqs=128)
     train_ds = build_dataset(corpus[:-n_eval], ds_cfg)
@@ -106,22 +113,14 @@ def cmd_train_detector(args) -> int:
     _log(f"checkpoint saved to {args.model_dir}")
     # calibrate the file-detector operating point and re-save the sidecar:
     # an uncalibrated checkpoint operates `nerrf undo` at the 0.5 cut that
-    # measurably flags benign rotated logs (p≈0.80) — see
-    # pipeline.calibrate_file_threshold.  Best-effort: the weights above
-    # are already safe on disk.
-    try:
-        from nerrf_tpu.models import NerrfNet
-        from nerrf_tpu.pipeline import calibrate_file_threshold
+    # measurably flags benign rotated logs (p≈0.80).  Shared helper — the
+    # weights above are already safe on disk, and the helper guards the
+    # node-head / multi-controller cases this inline copy used to miss.
+    from nerrf_tpu.train.checkpoint import calibrate_and_resave
 
-        cal = calibrate_file_threshold(res.state.params, NerrfNet(model_cfg),
-                                       log=_log)
-        if cal is not None:
-            save_checkpoint(args.model_dir, res.state.params, model_cfg,
-                            calibration={"node_threshold": round(cal[0], 4),
-                                         "node_threshold_kind": cal[1]})
-    except Exception as e:  # noqa: BLE001 — checkpoint already safe
-        _log(f"calibration failed ({type(e).__name__}: {e}); "
-             "checkpoint keeps the 0.5 default threshold")
+    calibrate_and_resave(args.model_dir, res.state.params, model_cfg,
+                         node_loss_weight=train_cfg.node_loss_weight,
+                         log=_log)
     return 0 if res.metrics["edge_auc"] >= 0.9 else 1
 
 

@@ -58,6 +58,15 @@ class Trace:
     ground_truth: Optional[GroundTruth] = None
     labels: Optional[np.ndarray] = None  # float32 [N], 1.0 = attack event
     name: str = ""
+    # Exact file-level ground truth (synthetic traces only): the inode-
+    # canonical final paths of files whose CONTENT the attack destroyed.
+    # Rename-style attacks leave a `.lockbit3` suffix that labels alone can
+    # recover, but in-place/partial encryption mutates a file without ever
+    # renaming it — and a later *benign* rename (interleaved-backup) can move
+    # the victim to a name no attack event ever mentions.  Only the simulator
+    # knows the truth then; None means "derive from labels" (loaders of real
+    # traces, pipeline.attack_touched_files fallback).
+    victim_paths: Optional[frozenset] = None
 
 
 def load_ground_truth_csv(path: str | Path) -> GroundTruth:

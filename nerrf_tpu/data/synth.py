@@ -63,7 +63,40 @@ class SimConfig:
     #                           features carry zero signal
     #   "multi-process"       — attack sharded over 4 interleaved worker
     #                           pids, each encrypting a subset concurrently
+    #
+    # r4 stealth scenarios, each aimed at a specific blind spot of the
+    # indicator heuristic (VERDICT r3 item 3 — build an eval the heuristic
+    # *fails*; indicator set: threat-model.mdx:176-189):
+    #   "inplace-stealth"     — encrypt in place: O_RDWR chunked read/write
+    #                           sweeps, NO rename, extensions kept, recovery
+    #                           note named nothing like README.  Kills the
+    #                           suspicious-extension rule, the write→rename
+    #                           motif and the note-name rule at once.
+    #   "partial-encrypt"     — in-place encryption of only the head ~12% of
+    #                           each file (enough to destroy most formats):
+    #                           stays under any bytes-moved / rate trigger.
+    #   "interleaved-backup"  — in-place encryption racing the benign backup
+    #                           sweep over the SAME files; the backup then
+    #                           archives ciphertext and renames victims to
+    #                           .bak names no attack event ever wrote.
+    #   "exfil-encrypt"       — staged: full read-only exfil sweep to a /tmp
+    #                           staging file, a quiet dwell, then a partial
+    #                           in-place encrypt pass.
+    #   "benign-atomic-rewrite" — NO attack; an indexer rewrites every file
+    #                           via the atomic-save idiom (write .tmp, rename
+    #                           .tmp → file): the write→rename motif fires on
+    #                           every file, so the heuristic mass-flags a
+    #                           benign maintenance job (FP-undo probe).
     scenario: str = "standard"
+
+
+# Scenarios with no attack stream at all (hard-negative probes).
+BENIGN_SCENARIOS = frozenset({"benign-mass-rename", "benign-atomic-rewrite"})
+# Attack variants that never rename victims and keep extensions: invisible
+# to every indicator the heuristic implements.
+STEALTH_SCENARIOS = frozenset(
+    {"inplace-stealth", "partial-encrypt", "interleaved-backup",
+     "exfil-encrypt"})
 
 
 _BENIGN_SERVICES = (
@@ -91,6 +124,7 @@ class _Emitter:
     def __init__(self):
         self.records: list[dict] = []
         self.labels: list[float] = []
+        self.victims: list[bool] = []  # content-destroying attack events
 
     def emit(
         self,
@@ -106,6 +140,7 @@ class _Emitter:
         flags: int = 0,
         uid: int = 0,
         ret_val: int = 0,
+        victim: bool = False,
     ) -> None:
         # inode is assigned later, in TIME order (simulate_trace): the benign
         # and attack streams are emitted sequentially, so assigning here
@@ -128,6 +163,7 @@ class _Emitter:
             }
         )
         self.labels.append(1.0 if attack else 0.0)
+        self.victims.append(bool(victim and attack))
 
 
 def _emit_benign(em: _Emitter, cfg: SimConfig, rng: np.random.Generator, t0: int) -> None:
@@ -152,7 +188,14 @@ def _emit_benign(em: _Emitter, cfg: SimConfig, rng: np.random.Generator, t0: int
                         comm=comm, uid=uid, attack=False, nbytes=int(rng.integers(80, 400)))
         elif comm == "postgres":
             if r < 0.6:
-                em.emit(t, Syscall.WRITE, f"/var/lib/pg/base/{rng.integers(20)}.db",
+                db = f"/var/lib/pg/base/{rng.integers(20)}.db"
+                if r < 0.12:
+                    # databases legitimately open data files O_RDWR — keeps
+                    # the access mode informative but not attack-sufficient
+                    em.emit(t, Syscall.OPENAT, db, pid=pid, comm=comm,
+                            uid=uid, attack=False,
+                            flags=int(OpenFlags.O_RDWR))
+                em.emit(t, Syscall.WRITE, db,
                         pid=pid, comm=comm, uid=uid, attack=False,
                         nbytes=int(rng.integers(512, 8192)))
             elif r < 0.8:
@@ -230,6 +273,8 @@ def _emit_attack(em: _Emitter, cfg: SimConfig, rng: np.random.Generator, t0: int
     """Five-phase LockBit-style attack; returns (start_ns, end_ns)."""
     if cfg.scenario == "multi-process":
         return _emit_attack_multiprocess(em, cfg, rng, t0)
+    if cfg.scenario in STEALTH_SCENARIOS:
+        return _emit_attack_stealth(em, cfg, rng, t0)
     # benign-comm: reuse the benign python3 app worker's identity (pid 202,
     # the pids[] entry _emit_benign uses), so comm/pid features are useless
     pid = 202 if cfg.scenario == "benign-comm" else 4567
@@ -276,13 +321,14 @@ def _emit_attack(em: _Emitter, cfg: SimConfig, rng: np.random.Generator, t0: int
             em.emit(step(1, 3), Syscall.READ, src, pid=pid, comm=comm, attack=True,
                     nbytes=cfg.chunk_bytes)
             em.emit(step(1, 3), Syscall.WRITE, src, pid=pid, comm=comm, attack=True,
-                    nbytes=cfg.chunk_bytes)
+                    nbytes=cfg.chunk_bytes, victim=True)
             # rate limit: advance wall clock to respect encrypt_rate_bps
             t += int(cfg.chunk_bytes / cfg.encrypt_rate_bps * 1e9)
         # in-place rename to the ransom extension; the inode survives under
         # dst (no unlink — neither the reference simulator's rename-by-rewrite
         # endstate nor real LockBit leaves a deleted old name behind)
-        em.emit(step(), Syscall.RENAME, src, pid=pid, comm=comm, attack=True, new_path=dst)
+        em.emit(step(), Syscall.RENAME, src, pid=pid, comm=comm, attack=True,
+                new_path=dst, victim=True)
         t += drip_gap_ns  # slow-drip: long quiet gap before the next file
 
     # P4 ransom note
@@ -335,13 +381,13 @@ def _emit_attack_multiprocess(em: _Emitter, cfg: SimConfig,
                     nbytes=cfg.chunk_bytes)
             tw += int(rng.uniform(1, 3) * 1e6)
             em.emit(tw, Syscall.WRITE, src, pid=w, comm=comm, attack=True,
-                    nbytes=cfg.chunk_bytes)
+                    nbytes=cfg.chunk_bytes, victim=True)
             # each worker honors the rate limit independently (aggregate is
             # 4× — fast attacks are the easy case; interleaving is the test)
             tw += int(cfg.chunk_bytes / cfg.encrypt_rate_bps * 1e9)
         tw += int(rng.uniform(2, 10) * 1e6)
         em.emit(tw, Syscall.RENAME, src, pid=w, comm=comm, attack=True,
-                new_path=dst)
+                new_path=dst, victim=True)
         cursors[w] = tw
     end = max(cursors.values())
     note = f"{cfg.target_dir}/README_LOCKBIT.txt"
@@ -350,6 +396,157 @@ def _emit_attack_multiprocess(em: _Emitter, cfg: SimConfig,
     em.emit(end + int(2e7), Syscall.WRITE, note, pid=leader, comm=comm,
             attack=True, nbytes=1337)
     return start, end + int(2e7)
+
+
+def _emit_attack_stealth(em: _Emitter, cfg: SimConfig,
+                         rng: np.random.Generator, t0: int) -> tuple[int, int]:
+    """The r4 stealth family: no rename, extensions kept, no README-style
+    note — every indicator the closed-form heuristic keys on
+    (threat-model.mdx:176-189) is absent, so detection must come from the
+    access *structure*: one process O_RDWR-sweeping a directory with paired
+    read/write chunks in place, after a stat-discovery pass.
+
+    Variants (SimConfig.scenario):
+      inplace-stealth     full-file in-place encryption + an innocuously
+                          named recovery note
+      partial-encrypt     only the head ~12% of each file is overwritten
+                          (headers gone ⇒ file destroyed; bytes moved stay
+                          far below any volume trigger); no note
+      interleaved-backup  the benign backup sweep trails the encryptor over
+                          the same files, archiving ciphertext and renaming
+                          victims to .bak — the only renames in the trace
+                          are benign
+      exfil-encrypt       staged: read-only exfil of every file into a /tmp
+                          staging blob, a quiet dwell, then partial in-place
+                          encryption
+
+    The attacker runs as comm "python3" (the benign app worker's comm, a
+    compromised-app story) under its own pid, so neither comm nor open
+    flags alone can carry the class — postgres legitimately opens O_RDWR
+    (_emit_benign) and python3 is the densest benign identity.
+    """
+    scenario = cfg.scenario
+    pid, comm = 4821, "python3"
+    t = t0 + int(cfg.attack_start_sec * _NS)
+    start = t
+
+    def step(lo_ms=2, hi_ms=40):
+        nonlocal t
+        t += int(rng.uniform(lo_ms, hi_ms) * 1e6)
+        return t
+
+    # Light recon: two /proc touches — deliberately below the heuristic's
+    # burst weighting; the model's process head may still use it.
+    for p in ("/proc/self/status", "/proc/mounts"):
+        em.emit(step(), Syscall.OPENAT, p, pid=pid, comm=comm, attack=True,
+                flags=int(OpenFlags.O_RDONLY))
+        em.emit(step(), Syscall.READ, p, pid=pid, comm=comm, attack=True,
+                nbytes=int(rng.integers(512, 2048)))
+
+    # Target discovery (unavoidable for any file-targeting payload).
+    names = _target_file_names(rng, cfg.num_target_files)
+    for nm in names:
+        em.emit(step(1, 4), Syscall.STAT, f"{cfg.target_dir}/{nm}", pid=pid,
+                comm=comm, attack=True)
+
+    sizes = {nm: int(rng.integers(cfg.min_file_bytes, cfg.max_file_bytes))
+             for nm in names}
+
+    if scenario == "exfil-encrypt":
+        # Stage A: full read-only sweep, compressing into one staging blob.
+        stage = "/tmp/.sess_cache.bin"
+        for nm in names:
+            src = f"{cfg.target_dir}/{nm}"
+            em.emit(step(1, 5), Syscall.OPENAT, src, pid=pid, comm=comm,
+                    attack=True, flags=int(OpenFlags.O_RDONLY))
+            for _ in range(max(1, sizes[nm] // cfg.chunk_bytes)):
+                em.emit(step(1, 3), Syscall.READ, src, pid=pid, comm=comm,
+                        attack=True, nbytes=cfg.chunk_bytes)
+                em.emit(step(1, 3), Syscall.WRITE, stage, pid=pid, comm=comm,
+                        attack=True, nbytes=cfg.chunk_bytes // 3)
+        # Quiet dwell before the destructive stage (staged campaigns pause
+        # between exfil and impact).
+        t += int(min(0.15 * cfg.duration_sec, 30.0) * _NS)
+
+    frac = 0.12 if scenario in ("partial-encrypt", "exfil-encrypt") else 1.0
+    bk_pid, bk_comm = 208, "backup-agent"
+    bk_t = t  # trailing benign sweep's clock (interleaved-backup only)
+    for nm in names:
+        src = f"{cfg.target_dir}/{nm}"
+        em.emit(step(), Syscall.OPENAT, src, pid=pid, comm=comm, attack=True,
+                flags=int(OpenFlags.O_RDWR))
+        nchunks = max(1, int(sizes[nm] * frac) // cfg.chunk_bytes)
+        for _ in range(nchunks):
+            em.emit(step(1, 3), Syscall.READ, src, pid=pid, comm=comm,
+                    attack=True, nbytes=cfg.chunk_bytes)
+            em.emit(step(1, 3), Syscall.WRITE, src, pid=pid, comm=comm,
+                    attack=True, nbytes=cfg.chunk_bytes, victim=True)
+            t += int(cfg.chunk_bytes / cfg.encrypt_rate_bps * 1e9)
+        if scenario == "interleaved-backup":
+            # The backup job reaches each file only after the encryptor
+            # leaves it (it archives ciphertext), but its event stream — on
+            # its own clock — interleaves with the attacker's work on later
+            # files.  Its rename is the ONLY rename the trace contains, and
+            # it is benign: labels say so, and the victim set follows the
+            # inode to the .bak name (simulate_trace).
+            bk_t = max(bk_t, t + int(rng.uniform(5, 30) * 1e6))
+            em.emit(bk_t, Syscall.OPENAT, src, pid=bk_pid, comm=bk_comm,
+                    attack=False, flags=int(OpenFlags.O_RDONLY))
+            for _ in range(max(1, sizes[nm] // cfg.chunk_bytes)):
+                bk_t += int(rng.uniform(1, 3) * 1e6)
+                em.emit(bk_t, Syscall.READ, src, pid=bk_pid, comm=bk_comm,
+                        attack=False, nbytes=cfg.chunk_bytes)
+                bk_t += int(rng.uniform(1, 3) * 1e6)
+                em.emit(bk_t, Syscall.WRITE, f"/backup/archive/{nm}.gz",
+                        pid=bk_pid, comm=bk_comm, attack=False,
+                        nbytes=cfg.chunk_bytes // 2)
+            bk_t += int(rng.uniform(2, 10) * 1e6)
+            em.emit(bk_t, Syscall.RENAME, src, pid=bk_pid, comm=bk_comm,
+                    attack=False, new_path=src + ".bak")
+
+    end = max(t, bk_t)
+    if scenario == "inplace-stealth":
+        # A recovery note that matches no indicator: not README*, benign
+        # extension.
+        note = f"{cfg.target_dir}/how_to_recover.html"
+        em.emit(step(), Syscall.OPENAT, note, pid=pid, comm=comm, attack=True,
+                flags=int(OpenFlags.O_WRONLY))
+        em.emit(step(), Syscall.WRITE, note, pid=pid, comm=comm, attack=True,
+                nbytes=2048)
+        end = t
+    return start, end
+
+
+def _emit_benign_atomic_rewrite(em: _Emitter, cfg: SimConfig,
+                                rng: np.random.Generator, t0: int) -> None:
+    """Hard negative: an indexer refreshes every target file via the
+    atomic-save idiom — read src, write ``.tmp_reindex_NNN``, rename the
+    tmp over src.  The write→rename-by-the-same-process motif fires on
+    EVERY file (the tmp inode is written, then carried onto the target name
+    by the rename), so the indicator heuristic mass-flags a routine
+    maintenance job; labels mark all of it benign.  This is the FP-undo
+    probe aimed at the motif rule specifically, the counterpart of
+    benign-mass-rename (which targets extension/rename-volume rules)."""
+    pid, comm = 209, "python3"
+    t = t0 + int(cfg.attack_start_sec * _NS)
+    names = _target_file_names(rng, cfg.num_target_files)
+    for i, nm in enumerate(names):
+        src = f"{cfg.target_dir}/{nm}"
+        tmp = f"{cfg.target_dir}/.tmp_reindex_{i:03d}"
+        em.emit(t, Syscall.OPENAT, src, pid=pid, comm=comm, attack=False,
+                flags=int(OpenFlags.O_RDONLY))
+        size = int(rng.integers(cfg.min_file_bytes, cfg.max_file_bytes))
+        for _ in range(max(1, size // cfg.chunk_bytes)):
+            t += int(rng.uniform(1, 3) * 1e6)
+            em.emit(t, Syscall.READ, src, pid=pid, comm=comm, attack=False,
+                    nbytes=cfg.chunk_bytes)
+            t += int(rng.uniform(1, 3) * 1e6)
+            em.emit(t, Syscall.WRITE, tmp, pid=pid, comm=comm, attack=False,
+                    nbytes=cfg.chunk_bytes)
+        t += int(rng.uniform(2, 8) * 1e6)
+        em.emit(t, Syscall.RENAME, tmp, pid=pid, comm=comm, attack=False,
+                new_path=src)
+        t += int(rng.uniform(5, 20) * 1e6)
 
 
 def simulate_trace(cfg: SimConfig, name: str = "") -> Trace:
@@ -363,6 +560,8 @@ def simulate_trace(cfg: SimConfig, name: str = "") -> Trace:
     if cfg.scenario == "benign-mass-rename":
         # hard negative: structurally attack-like, labelled benign throughout
         _emit_benign_mass_rename(em, cfg, rng, t0)
+    elif cfg.scenario == "benign-atomic-rewrite":
+        _emit_benign_atomic_rewrite(em, cfg, rng, t0)
     elif cfg.attack:
         start, end = _emit_attack(em, cfg, rng, t0)
         gt = GroundTruth(
@@ -378,12 +577,18 @@ def simulate_trace(cfg: SimConfig, name: str = "") -> Trace:
     order = sorted(range(len(em.records)), key=lambda i: em.records[i]["ts_ns"])
     inodes = InodeTable()
     recs = []
+    victim_inos: set = set()
+    ino_final: dict = {}  # inode → canonical final path (rename dest wins)
     for i in order:
         r = em.records[i]
         r["inode"] = (
             inodes.carry_rename(r["path"], r["new_path"])
             if r["new_path"] else inodes.get(r["path"])
         )
+        if r["inode"]:
+            ino_final[r["inode"]] = r["new_path"] or r["path"]
+            if em.victims[i]:
+                victim_inos.add(r["inode"])
         recs.append(r)
     events = EventArrays.from_records(recs, strings)
     labels = np.asarray([em.labels[i] for i in order], np.float32)
@@ -393,7 +598,20 @@ def simulate_trace(cfg: SimConfig, name: str = "") -> Trace:
         ground_truth=gt,
         labels=labels,
         name=name or f"synth-seed{cfg.seed}",
+        # exact file-level truth, following each victim inode to its FINAL
+        # name (a benign rename may move it — interleaved-backup) — this is
+        # the same canonicalization rule pipeline._inode_to_path applies, so
+        # detection keys and ground-truth keys cannot drift
+        victim_paths=frozenset(ino_final[i] for i in victim_inos),
     )
+
+
+# The adversarial attack variants a hard-scenario corpus draws from, and
+# the fraction of attack traces they collectively take (split evenly);
+# mirrored by train/corpus.py for the sharded 100 h corpus.
+ATTACK_VARIANTS = ("slow-drip", "benign-comm", "multi-process",
+                   "inplace-stealth", "partial-encrypt",
+                   "interleaved-backup", "exfil-encrypt")
 
 
 def make_corpus(
@@ -403,12 +621,19 @@ def make_corpus(
     duration_sec: float = 240.0,
     num_target_files: int | tuple[int, int] = 12,
     benign_rate_hz: float | tuple[float, float] = 40.0,
+    hard_scenarios: bool = False,
 ) -> List[Trace]:
     """A corpus of independent runs (the ROADMAP.md:50 corpus, scaled by args).
 
     `num_target_files` / `benign_rate_hz` may be (lo, hi) ranges, drawn per
     trace, so corpus traces vary structurally and not just by sim seed.
-    """
+
+    ``hard_scenarios`` draws ~49% of attack traces from ATTACK_VARIANTS and
+    ~20% of benign traces from the two hard negatives, mirroring the
+    sharded corpus mix (train/corpus.py) — the in-memory path for training
+    a deployable detector (`nerrf train-detector`, the adversarial eval's
+    fresh-model leg).  Off by default: unit tests assume the standard
+    scenario's structure."""
     out = []
     for i in range(n_traces):
         # Bresenham-spread attack traces through the corpus so any contiguous
@@ -423,6 +648,18 @@ def make_corpus(
             float(rng.uniform(benign_rate_hz[0], benign_rate_hz[1]))
             if isinstance(benign_rate_hz, tuple) else benign_rate_hz
         )
+        scenario = "standard"
+        if hard_scenarios:
+            u = rng.random()
+            if attack:
+                slot = 0.49 / len(ATTACK_VARIANTS)
+                idx = int(u // slot)
+                if idx < len(ATTACK_VARIANTS):
+                    scenario = ATTACK_VARIANTS[idx]
+            elif u < 0.1:
+                scenario = "benign-mass-rename"
+            elif u < 0.2:
+                scenario = "benign-atomic-rewrite"
         cfg = SimConfig(
             duration_sec=duration_sec,
             attack=attack,
@@ -433,6 +670,7 @@ def make_corpus(
             chunk_bytes=32 * 1024,
             benign_rate_hz=rate,
             seed=base_seed + i,
+            scenario=scenario,
         )
         out.append(simulate_trace(cfg, name=f"corpus-{i}-{'atk' if attack else 'benign'}"))
     return out

@@ -45,12 +45,15 @@ class CorpusSpec:
     """Generation parameters (mirrors config.CorpusConfig at scale).
 
     ``hard_scenarios`` mixes the adversarial variants from data/synth.py
-    into the corpus — benign mass-renames among the benign traces, and
-    slow-drip / benign-comm / multi-process attacks among the attack traces
-    — so the trained detector sees hard negatives *and* hard positives,
-    not just the linearly-separable standard attack (the r1 verdict's
-    detector-difficulty critique; without this the trained model flags
-    100% of benign archive jobs in the attack directory)."""
+    into the corpus — benign mass-renames and atomic-rewrite jobs among the
+    benign traces, and the evasion variants (slow-drip / benign-comm /
+    multi-process + the r4 stealth family: inplace-stealth /
+    partial-encrypt / interleaved-backup / exfil-encrypt) among the attack
+    traces — so the trained detector sees hard negatives *and* hard
+    positives, not just the linearly-separable standard attack (the r1
+    verdict's detector-difficulty critique; the r3 verdict's item 3 adds
+    the stealth family: a detector that only ever sees rename-style
+    attacks learns the same shortcut the heuristic hard-codes)."""
 
     hours: float = 100.0
     duration_sec: float = 600.0
@@ -61,10 +64,11 @@ class CorpusSpec:
     eval_fraction: float = 0.1     # fraction of TRACES held out
     shard_windows: int = 2000      # samples per shard (~0.7 GB at f16)
     hard_scenarios: bool = True
-    # fraction of benign traces carrying the mass-rename hard negative, and
-    # of attack traces drawn from each adversarial variant
+    # fraction of benign traces carrying a hard negative (split evenly
+    # between mass-rename and atomic-rewrite), and of attack traces drawn
+    # from the adversarial variants (split evenly across ATTACK_VARIANTS)
     benign_hard_fraction: float = 0.2
-    attack_variant_fraction: float = 0.3   # split evenly across 3 variants
+    attack_variant_fraction: float = 0.49  # 7 variants × 7%; standard keeps 51%
     # Zero-drop capacity fitting (r2 verdict weak #3: the r2 corpus was cut
     # at 256n/512e while its own densest training window needed 599n/639e —
     # attack bursts, exactly the signal, were silently truncated).  When on,
@@ -129,15 +133,16 @@ def generate_corpus(
         if spec.hard_scenarios:
             u = trng.random()
             if is_attack[i]:
-                third = spec.attack_variant_fraction / 3.0
-                if u < third:
-                    scenario = "slow-drip"
-                elif u < 2 * third:
-                    scenario = "benign-comm"
-                elif u < 3 * third:
-                    scenario = "multi-process"
-            elif u < spec.benign_hard_fraction:
+                from nerrf_tpu.data.synth import ATTACK_VARIANTS as variants
+
+                slot = spec.attack_variant_fraction / len(variants)
+                idx = int(u // slot) if slot > 0 else len(variants)
+                if idx < len(variants):
+                    scenario = variants[idx]
+            elif u < spec.benign_hard_fraction / 2:
                 scenario = "benign-mass-rename"
+            elif u < spec.benign_hard_fraction:
+                scenario = "benign-atomic-rewrite"
         return SimConfig(
             num_target_files=int(trng.integers(max(4, spec.num_target_files // 2),
                                                spec.num_target_files + 1)),

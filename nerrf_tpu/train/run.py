@@ -174,31 +174,14 @@ def _finish(exp, cfg, out: Path, n_dev, metrics, steps_per_sec, params,
     # weights FIRST: calibration below is best-effort post-processing and
     # must never be able to lose a finished training run
     save_checkpoint(out / "model", params, cfg.model)
-    calibration = None
-    if cfg.node_loss_weight > 0 and jax.process_count() == 1:
-        # the held-out-calibrated file-detector operating point travels
-        # with the weights (see checkpoint.save_checkpoint); calibrated at
-        # file granularity through the deployed decision function — only
-        # meaningful when this experiment trained the node head.  Guarded
-        # to single-controller runs: model_detect pulls scores to host
-        # numpy, which multi-host sharded params don't support (and every
-        # process recomputing 4 incidents would be waste).
-        from nerrf_tpu.models import NerrfNet
-        from nerrf_tpu.pipeline import calibrate_file_threshold
+    # the held-out-calibrated file-detector operating point travels with
+    # the weights (shared helper: checkpoint.calibrate_and_resave guards
+    # the untrained-node-head and multi-controller cases)
+    from nerrf_tpu.train.checkpoint import calibrate_and_resave
 
-        try:
-            cal = calibrate_file_threshold(params, NerrfNet(cfg.model),
-                                           log=_log)
-        except Exception as e:  # noqa: BLE001 — checkpoint already safe
-            _log(f"calibration failed ({type(e).__name__}: {e}); "
-                 "checkpoint keeps the 0.5 default threshold")
-            cal = None
-        if cal is not None:
-            t, kind = cal
-            calibration = {"node_threshold": round(t, 4),
-                           "node_threshold_kind": kind}
-            save_checkpoint(out / "model", params, cfg.model,
-                            calibration=calibration)
+    calibration = calibrate_and_resave(out / "model", params, cfg.model,
+                                       node_loss_weight=cfg.node_loss_weight,
+                                       log=_log)
     report = {
         "experiment": exp.name,
         "backend": jax.default_backend(),

@@ -1,22 +1,23 @@
 #!/bin/bash
-# Round-3 chip-work queue: waits for the TPU tunnel, then runs the offline
+# Round-4 chip-work queue: waits for the TPU tunnel, then runs the offline
 # artifact producers serially.  Order matters — training first (its
 # checkpoint feeds the adversarial eval), then the evals, then the
 # benchmark of record last so it exercises warm compilation caches.
 #
-#   1. joint-100h training on the zero-drop corpus  → joint100h_r3.json
-#   2. adversarial eval vs that checkpoint          → adversarial_r3.json
-#   3. graph capacity + Pallas crossover            → graph_capacity.json
-#   4. planner throughput probe                     → mcts_tpu.log
-#   5. recovery benches (device planner)            → m{0,1}_recovery.json
-#   6. bench.py smoke                               → /tmp/bench_smoke.json
+#   1. joint-100h training on the r4 corpus        → runs/joint-100h
+#   2. adversarial eval vs that checkpoint         → adversarial_r4.json
+#   3. graph capacity + Pallas crossover           → graph_capacity.json
+#   4. planner throughput probe                    → mcts_tpu.log
+#   5. recovery benches (device planner)           → m{0,1}_recovery.json
+#   6. stream detector quality + calibration       → stream_probe_tpu.json
+#   7. bench.py smoke (MFU + compile-time fields)  → /tmp/bench_smoke.json
 #
 # Safe to re-run; each step is idempotent or overwrite-only.  Nothing here
 # git-commits — artifacts are reviewed and committed by hand.
 # Logs: /tmp/tpu_queue.log + per-step logs in /tmp.
 cd "$(dirname "$0")/.."
 log() { echo "[queue $(date +%H:%M:%S)] $*" >> /tmp/tpu_queue.log; }
-log "watcher started (r3)"
+log "watcher started (r4)"
 # the gate must exercise the full enumerate->compile->execute path: the
 # relay has been seen half-up (enumeration answering, remote_compile
 # refusing), which passes an enumeration-only check and then wedges the
@@ -37,18 +38,23 @@ wait_for_tpu() {
   log "TPU is up (fresh compile path verified)"
 }
 wait_for_tpu
-# require the REGENERATED corpus (auto-fit capacities + zero-drop proof in
-# the manifest) — training on the r2 truncated corpus would repeat weak #3
+# require the REGENERATED r4 corpus: auto-fit zero-drop manifest AND the
+# new stealth attack variants present — training the flagship on the r3
+# corpus would leave it blind to exactly the scenarios the r4 adversarial
+# eval measures (VERDICT r3 item 3)
 while ! python - <<'EOF' 2>/dev/null
 import json, sys
 m = json.load(open("datasets/corpus100/manifest.json"))
+sc = m.get("scenario_counts", {})
 sys.exit(0 if m.get("complete") and m.get("auto_fit")
-         and m.get("dropped", {}).get("windows", 1) == 0 else 1)
+         and m.get("dropped", {}).get("windows", 1) == 0
+         and sc.get("inplace-stealth", 0) > 0
+         and sc.get("benign-atomic-rewrite", 0) > 0 else 1)
 EOF
 do
-  log "waiting for zero-drop corpus100"; sleep 60
+  log "waiting for the r4 zero-drop corpus100 (stealth variants)"; sleep 60
 done
-log "1/6 joint-100h training"
+log "1/7 joint-100h training"
 # the corpus is ~10 GB and rotates shards through the chip each epoch; over
 # a ~0.5 GB/s tunnel the wall clock is transfer-bound, so budget generously
 # and rely on resume-from-checkpoint for the retry.  The tunnel has twice
@@ -58,50 +64,55 @@ log "1/6 joint-100h training"
 for attempt in 1 2 3; do
   wait_for_tpu
   timeout 7200 python -m nerrf_tpu.train.run --experiment joint-100h \
-    --out runs/joint-100h-r3 --ckpt-every 2000 > /tmp/joint100.log 2>&1
+    --out runs/joint-100h --ckpt-every 2000 > /tmp/joint100.log 2>&1
   rc=$?
   log "joint-100h attempt $attempt rc=$rc"
   [ $rc -eq 0 ] && break
 done
-if [ -f runs/joint-100h-r3/metrics.json ]; then
+if [ -f runs/joint-100h/metrics.json ]; then
   mkdir -p benchmarks/results
-  cp runs/joint-100h-r3/metrics.json benchmarks/results/joint100h_r3.json
+  cp runs/joint-100h/metrics.json benchmarks/results/joint100h_r4.json
   log "copied joint100h artifact"
 fi
-log "2/6 adversarial eval"
-if [ -f runs/joint-100h-r3/model/model_config.json ]; then
+log "2/7 adversarial eval (flagship checkpoint when present)"
+wait_for_tpu
+if [ -f runs/joint-100h/model/model_config.json ]; then
   timeout 3600 python benchmarks/run_adversarial_eval.py \
-    --out benchmarks/results/adversarial_r3.json \
-    --model-dir runs/joint-100h-r3/model > /tmp/adv5.log 2>&1
+    --out benchmarks/results/adversarial_r4.json \
+    --model-dir runs/joint-100h/model > /tmp/adv_r4.log 2>&1
 else
   timeout 3600 python benchmarks/run_adversarial_eval.py \
-    --out benchmarks/results/adversarial_r3.json > /tmp/adv5.log 2>&1
+    --out benchmarks/results/adversarial_r4.json > /tmp/adv_r4.log 2>&1
 fi
 log "adversarial rc=$?"
-log "3/6 graph capacity (pallas crossover)"
+log "3/7 graph capacity (pallas crossover)"
+wait_for_tpu
 timeout 1800 python benchmarks/run_graph_capacity.py \
   --out benchmarks/results/graph_capacity.json > /tmp/graphcap.log 2>&1
 log "graphcap rc=$?"
-log "4/6 planner throughput probe"
+log "4/7 planner throughput probe"
 timeout 1200 python benchmarks/run_planner_probe.py > /tmp/mcts_tpu.log 2>&1
 log "mcts rc=$?"
-log "5/6 recovery benches (device planner in the KPI path)"
+log "5/7 recovery benches (device planner in the KPI path)"
+wait_for_tpu
 timeout 1800 python benchmarks/run_recovery_bench.py --scale m0 \
   --out benchmarks/results/m0_recovery.json > /tmp/recovery_m0.log 2>&1
 log "m0 recovery rc=$?"
 timeout 1800 python benchmarks/run_recovery_bench.py --scale m1 \
   --out benchmarks/results/m1_recovery.json > /tmp/recovery_m1.log 2>&1
 log "m1 recovery rc=$?"
-log "6/6 bench.py smoke (validates the driver's benchmark of record)"
-timeout 3600 python bench.py > /tmp/bench_smoke.json 2> /tmp/bench_smoke.log
-log "bench rc=$?"
-log "6b: chip-gated compiled-kernel test"
+log "6/8 stream detector quality + calibration on chip"
+wait_for_tpu
+timeout 2400 python benchmarks/run_stream_eval.py --steps 1500 \
+  --out benchmarks/results/stream_probe_tpu.json > /tmp/stream_tpu.log 2>&1
+log "stream quality rc=$?"
+log "7/8 chip-gated compiled-kernel test"
+wait_for_tpu
 NERRF_TEST_REAL_BACKEND=1 timeout 1200 python -m pytest \
   tests/test_pallas_ops.py -q -k compiled_on_tpu > /tmp/pallas_tpu.log 2>&1
 log "pallas chip test rc=$?"
-log "6c: stream detector quality on chip"
-timeout 1800 python benchmarks/run_stream_eval.py --steps 600 \
-  --train-traces 14 \
-  --out benchmarks/results/stream_probe_tpu.json > /tmp/stream_tpu.log 2>&1
-log "stream quality rc=$?"
+log "8/8 bench.py smoke (validates the driver's benchmark of record: MFU + compile fields)"
+wait_for_tpu
+timeout 3600 python bench.py > /tmp/bench_smoke.json 2> /tmp/bench_smoke.log
+log "bench rc=$?"
 log "queue done"

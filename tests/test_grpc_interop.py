@@ -1,0 +1,132 @@
+"""Foreign-client interop: the hand-rolled HTTP/2+HPACK gRPC server
+(native/src/h2grpc.cc) must speak to a STOCK third-party gRPC stack.
+
+This is the reference's grpcurl flow (`tracker/scripts/test.sh:76-82`) done
+with the real grpcio library (VERDICT r3 item 6): a hand-rolled H2 server
+that has only ever met its own clients would never see an interop bug in
+SETTINGS handling, connection/stream flow-control windows, or HPACK dynamic
+table state.  The daemon runs in `--synthetic` mode — the full
+encode→batch→broadcast→HTTP/2 path with a fabricated workload — so the
+test needs no BPF permission and never skips on capability.
+
+Unlike test_capture.py (live kernel events, skips without CAP_BPF), the
+only skips here are a failed native build or a missing grpcio.
+"""
+
+import re
+import subprocess
+import time
+from pathlib import Path
+
+import pytest
+
+grpc = pytest.importorskip("grpc")
+
+REPO = Path(__file__).resolve().parent.parent
+DAEMON = REPO / "native" / "build" / "nerrf-trackerd"
+_METHOD = "/nerrf.trace.Tracker/StreamEvents"
+
+
+@pytest.fixture(scope="module")
+def synthetic_daemon():
+    if not DAEMON.exists():
+        r = subprocess.run(
+            ["make", "-C", str(REPO / "native"), "build/nerrf-trackerd"],
+            capture_output=True, text=True)
+        if r.returncode != 0:
+            pytest.skip(f"daemon build failed: {r.stderr[-400:]}")
+    # ephemeral port (`:0`): a fixed port collides with concurrent pytest
+    # runs or a leaked daemon from an interrupted session; the daemon logs
+    # the resolved port on its serving line
+    proc = subprocess.Popen(
+        [str(DAEMON), "--listen", "127.0.0.1:0",
+         "--synthetic", "2000", "--max-seconds", "120"],
+        stderr=subprocess.PIPE, text=True)
+    port = None
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        line = proc.stderr.readline()
+        if not line:
+            break
+        m = re.search(r"serving StreamEvents on .* \(port (\d+)\)", line)
+        if m:
+            port = int(m.group(1))
+            break
+    assert port, "daemon never reported its serving port"
+    assert proc.poll() is None
+    yield port
+    proc.terminate()
+    proc.wait(timeout=10)
+
+
+def test_stock_grpc_client_streams_events(synthetic_daemon):
+    """≥100 events must arrive through grpcio's own HTTP/2 machinery and
+    decode as valid EventBatch frames."""
+    from nerrf_tpu.ingest import trace_pb2
+
+    port = synthetic_daemon
+    events = []
+    with grpc.insecure_channel(f"127.0.0.1:{port}") as channel:
+        call = channel.unary_stream(
+            _METHOD,
+            request_serializer=lambda m: m.SerializeToString(),
+            response_deserializer=lambda b: b,
+        )(trace_pb2.Empty(), timeout=30.0)
+        for frame in call:
+            batch = trace_pb2.EventBatch()
+            batch.ParseFromString(frame)
+            events.extend(batch.events)
+            if len(events) >= 150:
+                call.cancel()
+                break
+    assert len(events) >= 100, f"only {len(events)} events arrived"
+    # the synthetic workload is the canonical triple; field content must
+    # round-trip through protobuf exactly
+    syscalls = {e.syscall for e in events}
+    assert {"openat", "write", "rename"} <= syscalls
+    renames = [e for e in events if e.syscall == "rename"]
+    assert renames and all(e.new_path.endswith(".lockbit3") for e in renames)
+    writes = [e for e in events if e.syscall == "write"]
+    assert writes and all(e.bytes == 4096 for e in writes)
+    assert all(e.pid == 4242 for e in events)
+    assert all(e.comm == "synthload" for e in events)
+    # wall-clock timestamps (monotonic→wall corrected server-side)
+    now = time.time()
+    assert all(abs(e.ts.seconds - now) < 3600 for e in events[:10])
+
+
+def test_stock_grpc_client_ingest_bridge_path(synthetic_daemon):
+    """The deployed ingest path — TrackerClient (grpcio) → native C++ frame
+    decode — against the native daemon."""
+    from nerrf_tpu.ingest.service import TrackerClient
+    from nerrf_tpu.schema.events import Syscall
+
+    client = TrackerClient(f"127.0.0.1:{synthetic_daemon}")
+    events, strings = client.stream(max_events=150, timeout=30.0)
+    assert events.num_valid >= 100
+    seen = {int(s) for s in events.syscall[events.valid]}
+    assert {int(Syscall.OPENAT), int(Syscall.WRITE),
+            int(Syscall.RENAME)} <= seen
+    paths = {strings.lookup(int(i)) for i in events.path_id[events.valid]}
+    assert any(p.startswith("/app/uploads/doc_") for p in paths)
+
+
+def test_two_concurrent_stock_clients(synthetic_daemon):
+    """Per-subscriber queues + H2 stream multiplexing: two grpcio channels
+    must each receive an independent copy of the stream."""
+    from nerrf_tpu.ingest.service import TrackerClient
+
+    results = []
+    import threading
+
+    def drain():
+        c = TrackerClient(f"127.0.0.1:{synthetic_daemon}")
+        ev, _ = c.stream(max_events=80, timeout=30.0)
+        results.append(ev.num_valid)
+
+    ts = [threading.Thread(target=drain) for _ in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=40)
+    assert len(results) == 2 and all(n >= 80 for n in results), results
