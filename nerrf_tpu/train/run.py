@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 from pathlib import Path
@@ -217,13 +218,30 @@ def main(argv=None) -> int:
                     help="override the experiment's num_steps")
     ap.add_argument("--ckpt-every", type=int, default=0,
                     help="elastic full-state checkpoints every N steps")
+    ap.add_argument("--platform", default=None,
+                    help="force a JAX platform (e.g. 'cpu') before backend "
+                         "init — env vars can't override the axon "
+                         "sitecustomize on this host, jax.config can")
     args = ap.parse_args(argv)
     # Multi-host: join the cluster BEFORE any backend use.  Set
     # NERRF_COORDINATOR/NERRF_NUM_PROCESSES/NERRF_PROCESS_ID per process
     # (architecture.mdx:165-189's cross-node deploy, the jax way).
-    from nerrf_tpu.utils import enable_compilation_cache
+    from nerrf_tpu.utils import enable_compilation_cache, ensure_backend_or_cpu
 
     enable_compilation_cache()
+    if args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
+    if args.platform != "cpu" and not os.environ.get("NERRF_COORDINATOR"):
+        # single-controller path: same probe-or-degrade guard as the CLI —
+        # a wedged accelerator tunnel otherwise hangs the first traced op
+        # indefinitely (observed live).  Only 'cpu' is probe-free (it
+        # cannot hang on a dead tunnel — bench.py's rule); a *forced
+        # accelerator* platform still probes.  Multi-host runs skip it:
+        # the coordinator barrier has its own timeout and a CPU fallback
+        # would silently split the cluster.
+        ensure_backend_or_cpu("train-run", timeout_sec=150.0)
     from nerrf_tpu.parallel import init_distributed
 
     if init_distributed():

@@ -12,12 +12,19 @@ infra repo: the content is the product, the chrome is 200 lines.
 
 Supported markdown: ATX headings, fenced code blocks, inline code, links,
 bold/italic, unordered/ordered lists, tables, blockquotes, hrs.
+
+Search: every build also emits `search_index.js` — a per-section index
+(page, heading, anchor, text) — and the nav carries a search box filtering
+it client-side.  The reference site's search capability
+(`docs/lib/source.ts`, fumadocs' search API) without a server: the index
+ships as a script tag so it works from file:// too.
 """
 
 from __future__ import annotations
 
 import argparse
 import html
+import json
 import re
 import shutil
 import sys
@@ -60,6 +67,51 @@ th { background:var(--code-bg); }
 blockquote { border-left:3px solid var(--accent); margin:.8rem 0;
              padding:.1rem 1rem; color:var(--muted); }
 hr { border:none; border-top:1px solid var(--border); margin:2rem 0; }
+#q { width:100%; margin:.2rem 0 .6rem; padding:.35rem .5rem; font-size:.9rem;
+     border:1px solid var(--border); border-radius:6px; }
+#hits a { display:block; font-size:.85rem; padding:.25rem .5rem;
+          color:var(--fg); }
+#hits a b { color:var(--accent); }
+#hits small { color:var(--muted); display:block; font-weight:400; }
+"""
+
+_SEARCH_JS = """
+(function () {
+  var q = document.getElementById('q'), hits = document.getElementById('hits');
+  var nav = document.getElementById('navlinks');
+  if (!q || typeof SEARCH_INDEX === 'undefined') return;
+  q.addEventListener('input', function () {
+    var terms = q.value.toLowerCase().split(/\\s+/).filter(Boolean);
+    if (!terms.length) { hits.innerHTML = ''; nav.style.display = ''; return; }
+    var scored = [];
+    for (var i = 0; i < SEARCH_INDEX.length; i++) {
+      var e = SEARCH_INDEX[i], h = e.heading.toLowerCase(),
+          t = e.text.toLowerCase(), score = 0, ok = true;
+      for (var j = 0; j < terms.length; j++) {
+        var in_h = h.indexOf(terms[j]) >= 0, in_t = t.indexOf(terms[j]) >= 0;
+        if (!in_h && !in_t) { ok = false; break; }
+        score += in_h ? 3 : 1;
+      }
+      if (ok) scored.push([score, e]);
+    }
+    scored.sort(function (a, b) { return b[0] - a[0]; });
+    nav.style.display = scored.length ? 'none' : '';
+    hits.innerHTML = scored.slice(0, 15).map(function (se) {
+      var e = se[1];
+      var pos = e.text.toLowerCase().indexOf(terms[0]);
+      var snip = pos >= 0 ? e.text.slice(Math.max(0, pos - 30), pos + 60)
+                          : e.text.slice(0, 80);
+      var esc = function (s) {
+        return s.replace(/&/g, '&amp;').replace(/</g, '&lt;')
+                .replace(/>/g, '&gt;');
+      };
+      var href = e.page + '.html' + (e.anchor ? '#' + e.anchor : '');
+      return '<a href="' + href + '"><b>'
+        + esc(e.title) + '</b> \\u203a ' + esc(e.heading)
+        + '<small>\\u2026' + esc(snip) + '\\u2026</small></a>';
+    }).join('');
+  });
+})();
 """
 
 
@@ -79,11 +131,22 @@ def _rewrite_href(href: str) -> str:
     return href
 
 
+def _slug(text: str, seen: dict | None = None) -> str:
+    s = re.sub(r"[^a-z0-9]+", "-", text.lower()).strip("-") or "section"
+    if seen is not None:
+        n = seen.get(s, 0)
+        seen[s] = n + 1
+        if n:
+            s = f"{s}-{n}"
+    return s
+
+
 def md_to_html(text: str) -> str:
     out: list[str] = []
     lines = text.splitlines()
     i = 0
     in_list = None  # "ul" | "ol"
+    slugs: dict = {}
 
     def close_list():
         nonlocal in_list
@@ -125,7 +188,10 @@ def md_to_html(text: str) -> str:
         if m:
             close_list()
             lvl = len(m.group(1))
-            out.append(f"<h{lvl}>{_inline(m.group(2))}</h{lvl}>")
+            # anchor ids: search results and cross-page deep links land on
+            # the section, not the page top (same slug policy as the index)
+            out.append(f'<h{lvl} id="{_slug(m.group(2), slugs)}">'
+                       f"{_inline(m.group(2))}</h{lvl}>")
             i += 1
             continue
         if re.match(r"^\s*([-*])\s+", line):
@@ -193,6 +259,47 @@ def _title_of(md: str, fallback: str) -> str:
     return fallback
 
 
+def extract_sections(page: str, title: str, md: str) -> list[dict]:
+    """Per-heading search-index entries.  The slug sequence MUST mirror
+    md_to_html's (same helper, same order) or anchors drift; code-fence
+    content is indexed too — operators search for flag names and API
+    strings at least as often as prose."""
+    entries: list[dict] = []
+    slugs: dict = {}
+    heading, anchor, buf = title, "", []
+
+    def flush():
+        # a page's pre-heading preamble flushes with anchor "" — the search
+        # UI links it to the page top (no fragment); the anchor-resolution
+        # test exempts it for the same reason
+        text = " ".join(" ".join(buf).split())
+        if text:
+            entries.append({"page": page, "title": title, "heading": heading,
+                            "anchor": anchor, "text": text[:400]})
+
+    in_fence = False
+    for line in md.splitlines():
+        if line.startswith("```"):
+            in_fence = not in_fence
+            continue
+        if in_fence:
+            # fence content is indexed but its `# comments` are NOT
+            # headings — md_to_html never slugs them, and treating them as
+            # such would desynchronize the slug sequence (measured: six
+            # dangling anchors on quick-start/scaling)
+            buf.append(line)
+            continue
+        m = re.match(r"^(#{1,4})\s+(.*)", line)
+        if m:
+            flush()
+            heading, buf = m.group(2), []
+            anchor = _slug(m.group(2), slugs)
+        else:
+            buf.append(re.sub(r"[`*|>\[\]()#]", " ", line))
+    flush()
+    return entries
+
+
 def build(out_dir: Path) -> list[Path]:
     out_dir.mkdir(parents=True, exist_ok=True)
     pages = {p.stem: p.read_text() for p in DOCS.glob("*.md")}
@@ -200,7 +307,12 @@ def build(out_dir: Path) -> list[Path]:
         n for n in pages if n not in ORDER)
     titles = {n: _title_of(pages[n], n.replace("-", " ").title())
               for n in order}
-    written = []
+    index: list[dict] = []
+    for name in order:
+        index.extend(extract_sections(name, titles[name], pages[name]))
+    (out_dir / "search_index.js").write_text(
+        "const SEARCH_INDEX = " + json.dumps(index) + ";\n")
+    written = [out_dir / "search_index.js"]
     for name in order:
         nav = "\n".join(
             f'<a href="{n}.html"{" class=\"active\"" if n == name else ""}>'
@@ -211,8 +323,13 @@ def build(out_dir: Path) -> list[Path]:
 <meta name="viewport" content="width=device-width, initial-scale=1">
 <title>{html.escape(titles[name])} — NERRF-TPU</title>
 <style>{_CSS}</style></head>
-<body><nav><h2>NERRF-TPU</h2>{nav}</nav>
-<main>{body}</main></body></html>
+<body><nav><h2>NERRF-TPU</h2>
+<input id="q" type="search" placeholder="Search docs…" autocomplete="off">
+<div id="hits"></div>
+<div id="navlinks">{nav}</div></nav>
+<main>{body}</main>
+<script src="search_index.js"></script>
+<script>{_SEARCH_JS}</script></body></html>
 """
         path = out_dir / f"{name}.html"
         path.write_text(doc)

@@ -67,3 +67,36 @@ def test_docs_site_builds(tmp_path):
     index = (out / "index.html").read_text()
     for page in pages:
         assert page in index  # nav links every page
+
+
+def test_docs_site_search_index(tmp_path):
+    """Search capability (reference: fumadocs search API): the build emits a
+    per-section index whose every anchor resolves to a real heading id, and
+    each page wires in the search box + index script."""
+    import json
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    repo = Path(__file__).resolve().parent.parent
+    out = tmp_path / "site"
+    r = subprocess.run(
+        [sys.executable, str(repo / "scripts" / "build_docs.py"),
+         "--out", str(out)],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr
+    raw = (out / "search_index.js").read_text()
+    entries = json.loads(raw[raw.index("["): raw.rindex(";")])
+    assert len(entries) >= 40  # every guide contributes sections
+    html_cache = {}
+    for e in entries:
+        page = html_cache.setdefault(
+            e["page"], (out / f"{e['page']}.html").read_text())
+        if e["anchor"]:  # pre-heading preamble entries link to the page top
+            assert f'id="{e["anchor"]}"' in page, (e["page"], e["anchor"])
+        assert e["text"]  # no empty sections indexed
+    # searchable content includes code-fence strings (operators search
+    # for flags/commands), e.g. the CLI name somewhere in the corpus
+    assert any("nerrf" in e["text"] for e in entries)
+    index_html = (out / "index.html").read_text()
+    assert 'id="q"' in index_html and "search_index.js" in index_html
