@@ -90,23 +90,6 @@ def _attacked_files(trace) -> tuple[set, set]:
     return attack_touched_files(trace)
 
 
-def _benign_touched_files(trace) -> set:
-    """Files written/renamed by benign events (what an FP undo would hurt)."""
-    from nerrf_tpu.schema.events import Syscall
-
-    ev, st = trace.events, trace.strings
-    labels = trace.labels
-    out = set()
-    for i in range(len(ev)):
-        if not ev.valid[i] or (labels is not None and labels[i] >= 0.5):
-            continue
-        if int(ev.syscall[i]) in (int(Syscall.WRITE), int(Syscall.RENAME)):  # noqa: keep narrower than MUTATING_SYSCALLS: an unlinked benign file has no surviving content an undo could clobber
-            p = st.lookup(int(ev.new_path_id[i])) or st.lookup(int(ev.path_id[i]))
-            if p:
-                out.add(p)
-    return out
-
-
 def _file_metrics(items, detect) -> dict:
     """items: (trace, payload) pairs; ``detect(item)`` → DetectionResult.
     Payload carries a precomputed detection so aggregation variants don't

@@ -178,11 +178,12 @@ def cmd_undo(args) -> int:
     domain = build_undo_domain(detection, manifest, root=str(victim))
     value = ValueNet.create()
     value.fit_to_domain(domain, num_rollouts=256, horizon=32, steps=200)
-    plan = make_planner(domain, value, MCTSConfig(
-        num_simulations=args.simulations), kind=args.planner).plan()
+    planner = make_planner(domain, value, MCTSConfig(
+        num_simulations=args.simulations), kind=args.planner)
+    plan = planner.plan()
     (inc / "plan.json").write_text(json.dumps(plan.to_dict(), indent=2))
-    _log(f"plan: {len(plan.actions)} actions, {plan.rollouts} rollouts "
-         f"@ {plan.rollouts_per_sec:.0f}/s")
+    _log(f"plan[{type(planner).__name__}]: {len(plan.actions)} actions, "
+         f"{plan.rollouts} rollouts @ {plan.rollouts_per_sec:.0f}/s")
 
     # --- sandbox gate: clone → replay the captured trace → rehearse --------
     if not args.no_gate:

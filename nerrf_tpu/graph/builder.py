@@ -69,7 +69,18 @@ AUX_VOCAB = AUX_COMM_BASE + AUX_COMM_BUCKETS
 #   19     mean inter-event gap (fraction of window)
 #   20     write/read byte ratio (the spec's "byte count ratio")
 #   21     is_process flag
-NODE_FEATURE_DIM = 22
+#   22     renamed-by-writer fraction: of this file's renames, the share
+#          done by a process that ALSO wrote the file in-window — the
+#          threat model's write→rename motif as a feature.  Separates
+#          logrotate's rename-only touch (0.0) from ransomware's
+#          encrypt-then-rename (1.0); measured r4: without it the probe
+#          model scored rotated logs p≈0.983, inseparable from stealth
+#          victims, and the zero-FP cut zeroed benign-comm detection.
+#   23     in-place-overwrite flag: some process both read and wrote this
+#          file in-window (the no-rename encryption signature; also fires
+#          on e.g. postgres data files, which is exactly the benign
+#          context the model must weigh).
+NODE_FEATURE_DIM = 24
 
 # Edge feature layout (float32):
 #   0..5   per-syscall event counts on this (src,dst) pair
@@ -454,6 +465,24 @@ def build_window_graph(
 
         e_lab = np.zeros(kept_edges, np.float32)
         np.maximum.at(e_lab, pair_id, ev_label[pe])
+
+        # motif features on the FILE nodes, from per-pair syscall counts
+        # (see layout slots 22/23): who renames vs who writes is pair-level
+        # information the per-node counters above cannot express
+        w_cnt = np.bincount(pair_id[is_write[pe]], minlength=kept_edges)
+        r_cnt = np.bincount(pair_id[is_read[pe]], minlength=kept_edges)
+        ren_cnt = np.bincount(pair_id[is_rename[pe]], minlength=kept_edges)
+        ren_total = np.bincount(dst, weights=ren_cnt.astype(np.float64),
+                                minlength=kept_nodes)
+        ren_by_writer = np.bincount(
+            dst, weights=(ren_cnt * (w_cnt > 0)).astype(np.float64),
+            minlength=kept_nodes)
+        nf[:kept_nodes, 22] = (
+            ren_by_writer / np.maximum(ren_total, 1.0)).astype(np.float32)
+        inplace = np.bincount(
+            dst, weights=((w_cnt > 0) & (r_cnt > 0)).astype(np.float64),
+            minlength=kept_nodes)
+        nf[:kept_nodes, 23] = (inplace > 0).astype(np.float32)
 
         # sort by destination node for segment-reduction message passing
         order = np.argsort(dst, kind="stable")

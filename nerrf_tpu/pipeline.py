@@ -381,10 +381,12 @@ def calibrate_file_thresholds(
     The calibration set covers the distributions the KPI eval measures (r3
     advisor: calibrating on standard incidents alone leaves the zero-FP
     cut's margin against the hard negatives unmeasured): ``n_traces``
-    standard incidents, two stealth incidents (inplace/partial — their
-    victims score lower than rename-style artifacts, and a cut calibrated
-    without them can sit above their scores), one benign-only trace, and
-    the two benign hard negatives (mass-rename, atomic-rewrite).
+    standard incidents; four evasive incidents (inplace-stealth,
+    partial-encrypt, benign-comm, exfil-encrypt — their victims score
+    lower than rename-style artifacts, and a cut calibrated without them
+    can sit above their scores and silently zero their detection); one
+    benign-only trace; and the two benign hard negatives (mass-rename,
+    atomic-rewrite).
 
     A zero-FP cut is tried FIRST: the dense benign cluster (rotated logs)
     tops out around p≈0.81 while true attack artifacts score ≥0.99, and a
@@ -418,6 +420,16 @@ def calibrate_file_thresholds(
                   seed=base_seed + 7001, **base),
         SimConfig(attack=True, scenario="partial-encrypt",
                   seed=base_seed + 7002, **base),
+        # the identity-camouflage and staged attacks score LOWER than
+        # rename-style artifacts; a cut calibrated without them sits above
+        # their victims and silently zeroes their detection (measured r4:
+        # benign-comm went 1.0 → 0.0 when the zero-FP cut tightened to
+        # 0.987) — the calibration set must contain every victim
+        # distribution the KPI eval measures
+        SimConfig(attack=True, scenario="benign-comm",
+                  seed=base_seed + 7006, **base),
+        SimConfig(attack=True, scenario="exfil-encrypt",
+                  seed=base_seed + 7007, **base),
         SimConfig(attack=False, seed=base_seed + 7003, **base),
         SimConfig(attack=False, scenario="benign-mass-rename",
                   seed=base_seed + 7004, **base),

@@ -175,49 +175,6 @@ def enable_compilation_cache() -> None:
         pass  # old jax or read-only home: run uncached
 
 
-def safe_default_backend(timeout_sec: float = 150.0) -> str:
-    """The default backend's platform name without risking an unbounded
-    hang: if this process already initialized a backend, ask it directly
-    (free); otherwise establish reachability via the bounded subprocess
-    probe first.  Returns "cpu" when the probe fails — callers choosing a
-    planner/device path degrade to the host path instead of hanging, which
-    is exactly what an incident responder needs from a wedged tunnel.
-    (Found live: `make_planner(kind='auto')` blocked the m0 recovery bench
-    for minutes on a dead axon relay.)"""
-    initialized = False
-    try:
-        from jax._src import xla_bridge
-
-        if hasattr(xla_bridge, "backends_are_initialized"):
-            initialized = bool(xla_bridge.backends_are_initialized())
-        else:  # older jax: fall back to the private registry
-            initialized = bool(xla_bridge._backends)
-    except Exception as e:
-        # visible degradation: without the peek every call pays a full
-        # subprocess probe even in a warm process
-        print(f"[nerrf] backend-initialized peek failed "
-              f"({type(e).__name__}: {e}); probing in a subprocess",
-              file=sys.stderr, flush=True)
-    if initialized:
-        import jax
-
-        return jax.default_backend()
-    ok, detail, _ = probe_backend(timeout_sec=timeout_sec)
-    if not ok:
-        # Report, but do NOT force jax_platforms here: this is a query, and
-        # permanently pinning a long-lived process to CPU over one transient
-        # probe failure would out-live the blip.  Entry points that go on to
-        # issue jax ops guard themselves with ensure_backend_or_cpu (which
-        # does force) before ever reaching this path.
-        print(f"[nerrf] accelerator unreachable ({detail}); "
-              f"reporting the CPU/host path", file=sys.stderr, flush=True)
-        return "cpu"
-    # reachable: the in-process init that follows is expected to succeed
-    import jax
-
-    return jax.default_backend()
-
-
 def ensure_backend_or_cpu(tag: str,
                           timeout_sec: float = 150.0) -> tuple[bool, str]:
     """Bounded reachability probe; on failure FORCE the CPU platform so the
