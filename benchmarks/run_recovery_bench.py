@@ -83,13 +83,17 @@ def main() -> int:
         # attack hasn't happened yet — nothing incident-specific leaks in.
         value = ValueNet.create()
         planner_cfg = MCTSConfig(num_simulations=args.simulations)
-        if args.planner != "host":
+        planner_kind = args.planner
+        if planner_kind != "host":
             # auto now means the device program on every backend (see
             # make_planner: 4.2× the host search even on CPU), so the
             # daemon-boot warmup runs for every non-host request — but a
             # failed warmup must not sink the bench when auto can still
             # fall back to the host search (explicit --planner device
-            # keeps the hard failure: the operator asked for that program)
+            # keeps the hard failure: the operator asked for that program).
+            # On failure, pin auto to host HERE: letting make_planner retry
+            # the identical build inside the measured window would charge
+            # the same compile failure to the artifact's plan time.
             from nerrf_tpu.planner.device_mcts import DeviceMCTS
 
             t_warm = time.perf_counter()
@@ -100,10 +104,11 @@ def main() -> int:
                 log(f"[{args.scale}] device planner warm "
                     f"({time.perf_counter() - t_warm:.1f}s boot-time compile)")
             except Exception as e:  # noqa: BLE001
-                if args.planner == "device":
+                if planner_kind == "device":
                     raise
                 log(f"[{args.scale}] device planner warmup failed "
-                    f"({type(e).__name__}: {e}); auto will fall back")
+                    f"({type(e).__name__}: {e}); using the host search")
+                planner_kind = "host"
 
         t_attack = time.perf_counter()
         trace, encrypted = run_file_attack(victim, cfg)
@@ -117,7 +122,7 @@ def main() -> int:
 
         domain = build_undo_domain(detection, manifest, root=str(victim))
         value.fit_to_domain(domain, num_rollouts=256, horizon=32, steps=200)
-        planner = make_planner(domain, value, planner_cfg, kind=args.planner)
+        planner = make_planner(domain, value, planner_cfg, kind=planner_kind)
         planner_kind = type(planner).__name__
         plan = planner.plan()
         t_plan = time.perf_counter() - t0 - t_detect

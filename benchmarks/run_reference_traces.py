@@ -137,9 +137,20 @@ def main(argv=None) -> int:
                  f"fp={entry[name]['fp_undo_rate']}")
         report["traces"][scale] = entry
 
-    report["note"] = ("88/149-event captures — sanity check that the "
-                      "pipeline parses and scores real eBPF tracker output; "
-                      "far too small to be a quality benchmark")
+    report["note"] = (
+        "88/149-event captures — sanity check that the pipeline parses and "
+        "scores real tracker output; far too small to be a quality "
+        "benchmark.  Measured finding (r4): the learned detector scores "
+        "these victims ~0.0006 — the reference's traces are LOG scrapes "
+        "(one event per file action, no read/write chunk sequences, no "
+        "recon burst), an order of magnitude below the syscall-granular "
+        "density the model trains on and eBPF capture produces "
+        "(threat-model.mdx:121-137 projects ~25k events for this "
+        "workload).  The extension-keyed heuristic trivially scores 1.0.  "
+        "Conclusion: the model's operating floor is real capture density; "
+        "below it the indicator heuristic remains the detector of record "
+        "— which is why heuristic_detect stays first in the undo CLI's "
+        "fallback chain.")
     report["wall_seconds"] = round(time.time() - t0, 1)
     out = Path(args.out)
     out.parent.mkdir(parents=True, exist_ok=True)

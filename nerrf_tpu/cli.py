@@ -149,6 +149,32 @@ def cmd_undo(args) -> int:
     from nerrf_tpu.planner.value_net import ValueNet
     from nerrf_tpu.rollback import RollbackExecutor, SandboxGate, SnapshotStore
 
+    # Daemon-boot warmup, BEFORE the MTTR clock: compile the bucketed
+    # device-search program (+ the value-net architecture) once, exactly
+    # like run_recovery_bench's boot step — otherwise the CLI pays the XLA
+    # compile inside the incident window that the published recovery
+    # numbers exclude, and on a cold cache that compile can cost more than
+    # the device search saves.  Best-effort: a failed warmup just means
+    # make_planner's auto falls back to the host search.
+    value = ValueNet.create()
+    planner_kind = args.planner
+    if planner_kind != "host":
+        try:
+            from nerrf_tpu.planner.device_mcts import DeviceMCTS
+
+            t_warm = time.perf_counter()
+            DeviceMCTS.warmup_for(
+                1, 1, cfg=MCTSConfig(num_simulations=args.simulations),
+                value_apply=value.apply_fn, value_params=value.params)
+            _log(f"device planner warm "
+                 f"({time.perf_counter() - t_warm:.1f}s boot-time compile)")
+        except Exception as e:  # noqa: BLE001
+            if planner_kind == "device":
+                raise  # the operator asked for that program specifically
+            _log(f"device planner warmup failed ({type(e).__name__}: {e}); "
+                 "using the host search")
+            planner_kind = "host"  # don't pay the same failure again in-window
+
     inc = Path(args.incident)
     meta = json.loads((inc / "incident.json").read_text())
     victim = Path(meta["target"])
@@ -176,10 +202,11 @@ def cmd_undo(args) -> int:
 
     # --- plan ---------------------------------------------------------------
     domain = build_undo_domain(detection, manifest, root=str(victim))
-    value = ValueNet.create()
+    # `value` was created at boot (before the MTTR clock) so its
+    # architecture is already compiled; fit_to_domain only retrains weights
     value.fit_to_domain(domain, num_rollouts=256, horizon=32, steps=200)
     planner = make_planner(domain, value, MCTSConfig(
-        num_simulations=args.simulations), kind=args.planner)
+        num_simulations=args.simulations), kind=planner_kind)
     plan = planner.plan()
     (inc / "plan.json").write_text(json.dumps(plan.to_dict(), indent=2))
     _log(f"plan[{type(planner).__name__}]: {len(plan.actions)} actions, "

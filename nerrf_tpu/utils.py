@@ -165,10 +165,35 @@ def enable_compilation_cache() -> None:
     if os.environ.get("JAX_COMPILATION_CACHE_DIR"):
         return  # operator already chose a location
     try:
+        import hashlib
+        import platform
+
         import jax
 
+        # Namespace by a host fingerprint: XLA:CPU persists AOT executables
+        # specialized to the COMPILING machine's ISA, and this cache dir
+        # outlives container moves between heterogeneous hosts.  Loading a
+        # foreign entry logs "machine type ... doesn't match" and risks
+        # SIGILL mid-run (observed live: avx512-AMX entries from an earlier
+        # round's host loading on a narrower Xeon).  A per-fingerprint
+        # subdir means a moved workspace recompiles once instead of
+        # gambling on foreign executables.
+        flags = ""
+        try:
+            with open("/proc/cpuinfo") as f:
+                for line in f:
+                    # x86 spells it "flags", aarch64 "Features" — missing
+                    # the latter would collapse all ARM hosts into one
+                    # namespace and resurrect the foreign-AOT risk there
+                    if line.startswith(("flags", "Features")):
+                        flags = " ".join(sorted(line.split(":", 1)[1].split()))
+                        break
+        except OSError:
+            pass
+        host = hashlib.sha256(
+            f"{platform.machine()}|{flags}".encode()).hexdigest()[:12]
         cache = os.path.join(
-            os.path.expanduser("~"), ".cache", "nerrf_tpu", "xla")
+            os.path.expanduser("~"), ".cache", "nerrf_tpu", "xla", host)
         os.makedirs(cache, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", cache)
     except Exception:

@@ -152,6 +152,13 @@ def test_compilation_cache_persists_entries(monkeypatch, tmp_path):
     # behavior the helper documents
     prev = jax.config.jax_persistent_cache_min_compile_time_secs
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    # the persistent-cache backend is a process singleton initialized at
+    # first use: in full-suite order an earlier jit has already bound it to
+    # the previous dir, so re-pointing the config needs an explicit reset
+    # (and another at exit, so later tests re-bind to the restored dir)
+    from jax._src import compilation_cache as _cc
+
+    _cc.reset_cache()
     try:
         before = set(os.listdir(cache_dir))
         salt = float(int(uuid.uuid4()) % 100003)  # unique HLO → new key
@@ -168,3 +175,4 @@ def test_compilation_cache_persists_entries(monkeypatch, tmp_path):
         jax.config.update("jax_persistent_cache_min_compile_time_secs", prev)
         if prev_dir is not None:
             jax.config.update("jax_compilation_cache_dir", prev_dir)
+        _cc.reset_cache()
