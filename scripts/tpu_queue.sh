@@ -34,8 +34,16 @@ sys.exit(0 if ok and detail.startswith('tpu') else 1)
 " 2>/dev/null
 }
 wait_for_tpu() {
-  while ! tpu_ok; do sleep 120; done
-  log "TPU is up (fresh compile path verified)"
+  # probe attempts are the round's evidence when the tunnel never comes
+  # up (VERDICT r3 item 1: "check in the watcher's attempt log as the
+  # artifact and say so") — one line per failed probe, timestamped
+  local n=0
+  while ! tpu_ok; do
+    n=$((n + 1))
+    log "tpu probe #$n failed (enumerate->compile->execute did not complete)"
+    sleep 120
+  done
+  log "TPU is up (fresh compile path verified after $n failed probes)"
 }
 wait_for_tpu
 # require the REGENERATED r4 corpus: auto-fit zero-drop manifest AND the
