@@ -184,6 +184,72 @@ def main() -> None:
             f"{achieved_tflops:.1f} TFLOP/s"
             + (f" ({mfu_pct:.1f}% MFU)" if mfu_pct else ""))
 
+    # --- real-density leg: the deployed bucket (4096n/8192e) ----------------
+    # builder.py:104-110: a ~25k-event real-eBPF window needs ~3.2k nodes /
+    # 4.4k edges, so the power-of-two deployment bucket is 4096/8192 — the
+    # corpus-fitted 1024/2048 flagship shape has never been the deployed
+    # density (VERDICT r4 weak #4).  Padded capacity IS the compute cost at
+    # that bucket (static shapes), so the same corpus re-padded measures the
+    # real step time.  Chip-only by default: one 4096-shape step costs
+    # ~7 min on this host's CPU, which would blow the degraded-run
+    # short-line contract; NERRF_BENCH_BIG=1 forces it for rehearsals.
+    big_bucket = None
+    if backend == "tpu" or os.environ.get("NERRF_BENCH_BIG") == "1":
+        try:
+            big_cfg = TrainConfig(model=JointConfig(), batch_size=8,
+                                  num_steps=max(2, bench_steps // 4),
+                                  learning_rate=2e-3, warmup_steps=2, seed=0)
+            big_ds_cfg = DatasetConfig(
+                graph=GraphConfig(window_sec=45.0, stride_sec=15.0,
+                                  max_nodes=4096, max_edges=8192),
+                seq_len=100, max_seqs=128,
+            )
+            big_ds = build_dataset(corpus[:6], big_ds_cfg)
+            big_state = jax.jit(lambda r: init_state(
+                model, big_cfg, big_ds.arrays, r))(jax.random.PRNGKey(1))
+            jax.block_until_ready(big_state.params)
+            big_step = make_train_step_scheduled(
+                model, big_cfg, big_ds.arrays,
+                make_idx_schedule(len(big_ds), big_cfg))
+            brng = jax.random.PRNGKey(4)
+            t0 = time.perf_counter()
+            big_state, bloss, _baux, brng = big_step(big_state, brng)
+            jax.block_until_ready(bloss)
+            compile_seconds["train_step_4096"] = round(
+                time.perf_counter() - t0, 1)
+            bsteps = big_cfg.num_steps - 1
+            t0 = time.perf_counter()
+            for _ in range(bsteps):
+                big_state, bloss, _baux, brng = big_step(big_state, brng)
+            jax.block_until_ready(bloss)
+            bdt = time.perf_counter() - t0
+            big_sps = bsteps / bdt
+            big_flops = flops_per_step(big_step, big_state, brng)
+            big_tflops, big_mfu = mfu(big_flops, big_sps, jax.devices()[0])
+            big_bucket = {
+                "shape": "4096n/8192e/128seq", "batch": big_cfg.batch_size,
+                "steps_per_sec": round(big_sps, 3),
+                "model_flops_per_step":
+                    round(big_flops) if big_flops else None,
+                "achieved_tflops":
+                    round(big_tflops, 2) if big_tflops else None,
+                "mfu_pct": round(big_mfu, 2) if big_mfu else None,
+                "num_steps": big_cfg.num_steps,
+            }
+            log(f"[bench] big bucket 4096n/8192e: {big_sps:.3f} steps/s"
+                + (f", {big_mfu:.1f}% MFU" if big_mfu else ""))
+        except Exception as e:
+            log(f"[bench] big-bucket leg failed: {e!r}")
+            big_bucket = {"error": f"{type(e).__name__}: {e}"}
+        finally:
+            # free the 4096-shape params+optimizer before the eval legs —
+            # on failure too, or one RESOURCE_EXHAUSTED here would cascade
+            # into OOMing every later leg of the benchmark of record
+            big_state = big_ds = big_step = bloss = _baux = None  # noqa: F841
+            import gc
+
+            gc.collect()
+
     # --- quality gate on held-out traces ------------------------------------
     metrics = evaluate(make_eval_fn(model), state.params, eval_ds, cfg.batch_size)
     log(f"[bench] eval: edge_auc={metrics['edge_auc']:.4f} "
@@ -413,6 +479,7 @@ def main() -> None:
         "achieved_tflops":
             round(achieved_tflops, 2) if achieved_tflops else None,
         "mfu_pct": round(mfu_pct, 2) if mfu_pct else None,
+        "big_bucket": big_bucket,
         "edge_roc_auc": round(metrics["edge_auc"], 4),
         "seq_f1": round(metrics["seq_f1"], 4),
         "mcts_rollouts_per_sec":

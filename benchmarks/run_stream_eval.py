@@ -193,6 +193,11 @@ def main(argv=None) -> int:
     calibration = {
         "stream_event_threshold": round(float(t_cal), 4),
         "stream_event_threshold_kind": "calib-split-best-f1",
+        # the cut lives in RAW LOGIT space (best_f1 sweeps event_logits,
+        # never sigmoided) — unlike the joint model's node_threshold, which
+        # is a probability.  Recorded explicitly so a consumer mirroring
+        # node_threshold usage can't mis-apply it (r4 advisor).
+        "stream_event_threshold_space": "logit",
         "calib_f1": round(float(calib_f1), 4),
     }
     if args.ckpt_dir:

@@ -150,7 +150,42 @@ bool hpack_int(const uint8_t *&p, const uint8_t *end, int prefix,
   return false;
 }
 
-// String literal: sets `opaque` when huffman-coded (content not decoded).
+#include "hpack_huffman.inc"
+
+// HPACK Huffman decode (RFC 7541 §5.2): greedy prefix match over the
+// canonical code — needed since reflection landed (two served paths means
+// a huffman-coded :path can no longer be treated as a wildcard match).
+bool huffman_decode(const uint8_t *p, size_t len, std::string *out) {
+  static const std::map<std::pair<uint8_t, uint32_t>, int> *rev = [] {
+    auto *m = new std::map<std::pair<uint8_t, uint32_t>, int>();
+    for (int i = 0; i < 257; ++i)
+      (*m)[{kHuff[i].bits, kHuff[i].code}] = i;
+    return m;
+  }();
+  uint32_t acc = 0;
+  uint8_t nbits = 0;
+  out->clear();
+  for (size_t i = 0; i < len; ++i) {
+    for (int b = 7; b >= 0; --b) {
+      acc = (acc << 1) | ((p[i] >> b) & 1);
+      ++nbits;
+      auto it = rev->find({nbits, acc});
+      if (it != rev->end()) {
+        if (it->second == 256) return false;  // EOS inside the stream
+        out->push_back(static_cast<char>(it->second));
+        acc = 0;
+        nbits = 0;
+      } else if (nbits > 30) {
+        return false;
+      }
+    }
+  }
+  // padding must be a proper EOS prefix: < 8 bits, all ones
+  return nbits < 8 && acc == (1u << nbits) - 1;
+}
+
+// String literal: huffman-coded strings are decoded; `opaque` is only set
+// when the coding is malformed (content then unknown, empty string).
 bool hpack_string(const uint8_t *&p, const uint8_t *end, std::string *out,
                   bool *opaque) {
   if (p >= end) return false;
@@ -158,10 +193,16 @@ bool hpack_string(const uint8_t *&p, const uint8_t *end, std::string *out,
   uint64_t len;
   if (!hpack_int(p, end, 7, &len)) return false;
   if (p + len > end) return false;
-  out->assign(reinterpret_cast<const char *>(p), len);
+  *opaque = false;
+  if (huff) {
+    if (!huffman_decode(p, static_cast<size_t>(len), out)) {
+      *out = "";  // content unknown
+      *opaque = true;
+    }
+  } else {
+    out->assign(reinterpret_cast<const char *>(p), len);
+  }
   p += len;
-  *opaque = huff;
-  if (huff) *out = "";  // content unknown
   return true;
 }
 
@@ -311,7 +352,238 @@ std::string lit(const std::string &name, const std::string &value) {
   return s;
 }
 
+// ---- minimal protobuf wire helpers (reflection) ---------------------------
+// The daemon already hand-writes its event protobufs (trackerd.cc); these
+// are the matching read-side walkers, scoped to what the reflection service
+// needs: varints, length-delimited fields, and two levels of nesting.
+
+void pb_varint(std::string *out, uint64_t v) {
+  while (v >= 0x80) {
+    out->push_back(static_cast<char>((v & 0x7f) | 0x80));
+    v >>= 7;
+  }
+  out->push_back(static_cast<char>(v));
+}
+
+void pb_bytes(std::string *out, int field, const std::string &s) {
+  pb_varint(out, (static_cast<uint64_t>(field) << 3) | 2);
+  pb_varint(out, s.size());
+  *out += s;
+}
+
+bool pb_read_varint(const uint8_t **p, const uint8_t *end, uint64_t *v) {
+  *v = 0;
+  int shift = 0;
+  while (*p < end && shift < 64) {
+    uint8_t b = *(*p)++;
+    *v |= static_cast<uint64_t>(b & 0x7f) << shift;
+    if (!(b & 0x80)) return true;
+    shift += 7;
+  }
+  return false;
+}
+
+// Walk one field; for length-delimited fields *val spans the payload.
+bool pb_next_field(const uint8_t **p, const uint8_t *end, int *field,
+                   int *wire, const uint8_t **val, size_t *len) {
+  if (*p >= end) return false;
+  uint64_t key;
+  if (!pb_read_varint(p, end, &key)) return false;
+  *field = static_cast<int>(key >> 3);
+  *wire = static_cast<int>(key & 7);
+  switch (*wire) {
+    case 0: {  // varint
+      uint64_t v;
+      *val = *p;
+      if (!pb_read_varint(p, end, &v)) return false;
+      *len = 0;
+      return true;
+    }
+    case 1:  // 64-bit
+      if (end - *p < 8) return false;
+      *val = *p;
+      *len = 8;
+      *p += 8;
+      return true;
+    case 2: {  // length-delimited
+      uint64_t n;
+      if (!pb_read_varint(p, end, &n) ||
+          n > static_cast<uint64_t>(end - *p))
+        return false;
+      *val = *p;
+      *len = static_cast<size_t>(n);
+      *p += n;
+      return true;
+    }
+    case 5:  // 32-bit
+      if (end - *p < 4) return false;
+      *val = *p;
+      *len = 4;
+      *p += 4;
+      return true;
+    default:
+      return false;
+  }
+}
+
+// name field (1) of a nested DescriptorProto/ServiceDescriptorProto/…
+std::string pb_name_of(const uint8_t *p, size_t len) {
+  const uint8_t *end = p + len;
+  int field, wire;
+  const uint8_t *val;
+  size_t vlen;
+  while (pb_next_field(&p, end, &field, &wire, &val, &vlen))
+    if (field == 1 && wire == 2)
+      return std::string(reinterpret_cast<const char *>(val), vlen);
+  return "";
+}
+
 }  // namespace
+
+// ---- reflection -----------------------------------------------------------
+
+void GrpcStreamServer::set_reflection_descriptor_set(
+    const std::string &fds_bytes) {
+  reflection_files_.clear();
+  const uint8_t *p = reinterpret_cast<const uint8_t *>(fds_bytes.data());
+  const uint8_t *end = p + fds_bytes.size();
+  int field, wire;
+  const uint8_t *val;
+  size_t len;
+  // FileDescriptorSet: repeated FileDescriptorProto file = 1
+  while (pb_next_field(&p, end, &field, &wire, &val, &len)) {
+    if (field != 1 || wire != 2) continue;
+    RefFile f;
+    f.bytes.assign(reinterpret_cast<const char *>(val), len);
+    const uint8_t *fp = val, *fend = val + len;
+    int ff, fw;
+    const uint8_t *fv;
+    size_t fl;
+    // FileDescriptorProto: name=1 package=2 dependency=3 message_type=4
+    // enum_type=5 service=6
+    while (pb_next_field(&fp, fend, &ff, &fw, &fv, &fl)) {
+      if (fw != 2) continue;
+      std::string s(reinterpret_cast<const char *>(fv), fl);
+      switch (ff) {
+        case 1: f.name = s; break;
+        case 2: f.pkg = s; break;
+        case 3: f.deps.push_back(s); break;
+        case 4: case 5: case 6: {
+          std::string n = pb_name_of(fv, fl);
+          if (n.empty()) break;
+          std::string full = f.pkg.empty() ? n : f.pkg + "." + n;
+          f.symbols.push_back(full);
+          if (ff == 6) f.services.push_back(full);
+          break;
+        }
+        default: break;
+      }
+    }
+    reflection_files_.push_back(std::move(f));
+  }
+}
+
+std::string GrpcStreamServer::reflect_reply(const std::string &request) const {
+  // ServerReflectionRequest: host=1 file_by_filename=3
+  // file_containing_symbol=4 file_containing_extension=5
+  // all_extension_numbers_of_type=6 list_services=7
+  const uint8_t *p = reinterpret_cast<const uint8_t *>(request.data());
+  const uint8_t *end = p + request.size();
+  int field, wire;
+  const uint8_t *val;
+  size_t len;
+  int which = 0;
+  std::string arg;
+  while (pb_next_field(&p, end, &field, &wire, &val, &len)) {
+    if (field >= 3 && field <= 7) {
+      which = field;
+      arg.assign(reinterpret_cast<const char *>(val), len);
+    }
+  }
+
+  std::string body;  // the message_response arm
+  int arm = 0;
+  auto files_response = [&](const RefFile *hit) {
+    // FileDescriptorResponse: repeated bytes file_descriptor_proto = 1 —
+    // the file plus its transitive deps resolved within the set
+    std::vector<const RefFile *> todo = {hit};
+    std::vector<const RefFile *> out;
+    while (!todo.empty()) {
+      const RefFile *f = todo.back();
+      todo.pop_back();
+      bool seen = false;
+      for (const RefFile *o : out) seen |= (o == f);
+      if (seen) continue;
+      out.push_back(f);
+      for (const std::string &d : f->deps)
+        for (const RefFile &g : reflection_files_)
+          if (g.name == d) todo.push_back(&g);
+    }
+    for (const RefFile *f : out) pb_bytes(&body, 1, f->bytes);
+    arm = 4;
+  };
+
+  switch (which) {
+    case 7: {  // list_services → ListServiceResponse{ServiceResponse name=1}
+      for (const RefFile &f : reflection_files_)
+        for (const std::string &svc : f.services) {
+          std::string sr;
+          pb_bytes(&sr, 1, svc);
+          pb_bytes(&body, 1, sr);
+        }
+      arm = 6;
+      break;
+    }
+    case 3: {  // file_by_filename
+      for (const RefFile &f : reflection_files_)
+        if (f.name == arg) {
+          files_response(&f);
+          break;
+        }
+      break;
+    }
+    case 4: {  // file_containing_symbol: exact or enclosing top-level symbol
+      for (const RefFile &f : reflection_files_) {
+        for (const std::string &sym : f.symbols)
+          if (arg == sym ||
+              (arg.size() > sym.size() && arg.compare(0, sym.size(), sym) == 0 &&
+               arg[sym.size()] == '.')) {
+            files_response(&f);
+            break;
+          }
+        if (arm) break;
+      }
+      break;
+    }
+    default:
+      break;
+  }
+  if (!arm) {
+    // ErrorResponse: error_code=1 (NOT_FOUND=5 / UNIMPLEMENTED=12),
+    // error_message=2
+    pb_varint(&body, (1 << 3) | 0);
+    pb_varint(&body, which == 5 || which == 6 ? 12 : 5);
+    pb_bytes(&body, 2, which == 5 || which == 6
+                           ? "extensions unsupported (proto3 schema)"
+                           : "not found: " + arg);
+    arm = 7;
+  }
+
+  // ServerReflectionResponse: valid_host=1 original_request=2 + arm
+  std::string msg;
+  pb_bytes(&msg, 2, request);
+  pb_bytes(&msg, arm, body);
+
+  // gRPC length-prefixed frame: 1-byte compressed flag + 4-byte BE length
+  std::string framed;
+  framed.push_back('\0');
+  framed.push_back(static_cast<char>((msg.size() >> 24) & 0xff));
+  framed.push_back(static_cast<char>((msg.size() >> 16) & 0xff));
+  framed.push_back(static_cast<char>((msg.size() >> 8) & 0xff));
+  framed.push_back(static_cast<char>(msg.size() & 0xff));
+  framed += msg;
+  return framed;
+}
 
 // ---- server ---------------------------------------------------------------
 
@@ -437,6 +709,9 @@ void GrpcStreamServer::handle_conn(int fd) {
     std::shared_ptr<FrameQueue> queue;
     std::string pending;  // bytes accepted from the queue, not yet sent
     bool open;
+    bool reflection = false;  // bidi ServerReflectionInfo stream
+    bool client_done = false;  // END_STREAM seen from the client
+    std::string inbuf;  // reflection request bytes not yet framed
   };
   std::map<uint32_t, Stream> streams;
 
@@ -520,7 +795,15 @@ void GrpcStreamServer::handle_conn(int fd) {
             alive = false;
             break;
           }
-          if (!opaque && !rpath.empty() && rpath != path_) {
+          bool is_reflect =
+              !reflection_files_.empty() &&
+              (rpath ==
+                   "/grpc.reflection.v1.ServerReflection/"
+                   "ServerReflectionInfo" ||
+               rpath ==
+                   "/grpc.reflection.v1alpha.ServerReflection/"
+                   "ServerReflectionInfo");
+          if (!is_reflect && !opaque && !rpath.empty() && rpath != path_) {
             // plaintext path mismatch → UNIMPLEMENTED trailers-only
             std::string h = std::string(1, char(0x88)) +
                             lit("content-type", "application/grpc") +
@@ -531,8 +814,11 @@ void GrpcStreamServer::handle_conn(int fd) {
           }
           Stream st;
           st.window = initial_stream_window;
-          st.queue = subscribe_ ? subscribe_() : nullptr;
+          st.reflection = is_reflect;
+          st.queue =
+              (!is_reflect && subscribe_) ? subscribe_() : nullptr;
           st.open = true;
+          st.client_done = (flags & kFlagEndStream) != 0;
           // response headers
           std::string h = std::string(1, char(0x88)) +
                           lit("content-type", "application/grpc");
@@ -541,16 +827,80 @@ void GrpcStreamServer::handle_conn(int fd) {
             break;
           }
           streams[sid] = std::move(st);
-          subscribers_.fetch_add(1);
+          if (!is_reflect) subscribers_.fetch_add(1);
           break;
         }
-        case kFrameData:
-          break;  // Empty request payload — nothing to do
+        case kFrameData: {
+          // event streams take Empty — nothing to do; reflection streams
+          // carry length-prefixed ServerReflectionRequest messages.
+          // Replenish the client's send windows for every DATA byte
+          // consumed: before reflection the only request payload was an
+          // ~empty Empty, but a long-lived reflection session sends real
+          // DATA and would stall forever at 64 KiB cumulative otherwise.
+          if (!payload.empty()) {
+            std::string inc(4, '\0');
+            inc[0] = static_cast<char>((payload.size() >> 24) & 0x7f);
+            inc[1] = static_cast<char>((payload.size() >> 16) & 0xff);
+            inc[2] = static_cast<char>((payload.size() >> 8) & 0xff);
+            inc[3] = static_cast<char>(payload.size() & 0xff);
+            if (!send_frame(fd, kFrameWindowUpdate, 0, 0, inc) ||
+                !send_frame(fd, kFrameWindowUpdate, 0, sid, inc)) {
+              alive = false;
+              break;
+            }
+          }
+          auto it = streams.find(sid);
+          if (it == streams.end()) break;
+          Stream &st = it->second;
+          if (st.reflection) {
+            const uint8_t *dp = pp;
+            size_t dlen = payload.size();
+            if (flags & 0x8) {  // PADDED
+              uint8_t pad = dlen ? dp[0] : 0;
+              if (pad + 1u <= dlen) {
+                dp += 1;
+                dlen -= 1 + pad;
+              } else {
+                dlen = 0;
+              }
+            }
+            st.inbuf.append(reinterpret_cast<const char *>(dp), dlen);
+            // drain complete gRPC frames: flag byte + 4-byte BE length.
+            // Real reflection requests are ≤ a few hundred bytes; a
+            // client-declared length past 64 KiB (or a runaway buffer) is
+            // treated as malformed rather than buffered toward 4 GiB.
+            constexpr size_t kMaxReflectMsg = 64 * 1024;
+            bool malformed = false;
+            while (st.inbuf.size() >= 5) {
+              const uint8_t *b =
+                  reinterpret_cast<const uint8_t *>(st.inbuf.data());
+              size_t mlen = (size_t(b[1]) << 24) | (size_t(b[2]) << 16) |
+                            (size_t(b[3]) << 8) | b[4];
+              if (mlen > kMaxReflectMsg) {
+                malformed = true;
+                break;
+              }
+              if (st.inbuf.size() < 5 + mlen) break;
+              st.pending += reflect_reply(st.inbuf.substr(5, mlen));
+              st.inbuf.erase(0, 5 + mlen);
+            }
+            if (malformed || st.inbuf.size() > kMaxReflectMsg + 5) {
+              // RESOURCE_EXHAUSTED trailers, drop the stream
+              std::string t = lit("grpc-status", "8");
+              send_frame(fd, kFrameHeaders,
+                         kFlagEndHeaders | kFlagEndStream, sid, t);
+              streams.erase(it);
+              break;
+            }
+          }
+          if (flags & kFlagEndStream) st.client_done = true;
+          break;
+        }
         case kFrameRstStream:
           if (streams.count(sid)) {
             if (streams[sid].queue) streams[sid].queue->close();
+            if (!streams[sid].reflection) subscribers_.fetch_sub(1);
             streams.erase(sid);
-            subscribers_.fetch_sub(1);
           }
           break;
         case kFrameGoaway:
@@ -586,12 +936,16 @@ void GrpcStreamServer::handle_conn(int fd) {
         conn_window -= static_cast<int64_t>(n);
         wrote = true;
       }
-      if (st.queue && st.queue->closed() && st.pending.empty()) {
+      bool done = st.reflection
+                      ? (st.client_done && st.pending.empty())
+                      : (st.queue && st.queue->closed() &&
+                         st.pending.empty());
+      if (done) {
         // source finished: trailers, END_STREAM
         std::string t = lit("grpc-status", "0");
         send_frame(fd, kFrameHeaders, kFlagEndHeaders | kFlagEndStream,
                    it->first, t);
-        subscribers_.fetch_sub(1);
+        if (!st.reflection) subscribers_.fetch_sub(1);
         it = streams.erase(it);
         continue;
       }
@@ -613,7 +967,7 @@ void GrpcStreamServer::handle_conn(int fd) {
   }
   for (auto &kv : streams) {
     if (kv.second.queue) kv.second.queue->close();
-    subscribers_.fetch_sub(1);
+    if (!kv.second.reflection) subscribers_.fetch_sub(1);
   }
   close_all();
   ::close(fd);

@@ -1,19 +1,20 @@
 // Minimal HTTP/2 server — exactly enough of RFC 7540/7541 to serve one gRPC
-// server-streaming method (`/nerrf.trace.Tracker/StreamEvents`) to standard
-// clients (grpcio, grpcurl, grpc-go).
+// server-streaming method (`/nerrf.trace.Tracker/StreamEvents`) plus the
+// standard gRPC server-reflection method to standard clients (grpcio,
+// grpcurl, grpc-go).
 //
 // Why hand-rolled: the build image has no grpc++ (and no package installs),
 // and the reference's tracker is a single self-contained native binary
 // (`/root/reference/tracker/cmd/tracker/main.go:113-148`).  Scope kept
 // deliberately small:
-//   * server side of one server-streaming RPC; request payload ignored
-//     (the method takes Empty);
+//   * server side of one server-streaming RPC (request payload ignored —
+//     the method takes Empty) plus the bidi reflection RPC;
 //   * HPACK is decoded structurally (integers, string lengths, dynamic-table
-//     bookkeeping).  Huffman-coded header *values* are not decoded — a
-//     huffman :path is accepted as a wildcard match, since this server binds
-//     exactly one method (same posture as grpc's generic handler).  Dynamic
-//     table sizes for huffman entries use the coded length (slight
-//     underestimate); fine for the one-RPC-per-connection gRPC pattern.
+//     bookkeeping) with full RFC 7541 §5.2 Huffman decoding of string
+//     literals — required once a second method (reflection) exists, since a
+//     huffman :path can no longer be treated as a wildcard match.  A
+//     *malformed* huffman string is carried as opaque (matches the events
+//     path, the pre-reflection posture).
 //   * flow control honored on both connection and stream windows;
 //     PING/SETTINGS/WINDOW_UPDATE/RST_STREAM/GOAWAY handled.
 #ifndef NERRF_H2GRPC_H_
@@ -69,18 +70,40 @@ class GrpcStreamServer {
   using OnPeer = std::function<void(int pid)>;
   void set_on_peer(OnPeer fn) { on_peer_ = fn; }
 
+  // Serve gRPC server reflection (v1 + v1alpha `ServerReflectionInfo`) from
+  // a serialized google.protobuf.FileDescriptorSet (protoc
+  // --include_imports output).  With it set, `grpcurl list/describe` works
+  // schema-free against this daemon, matching the reference tracker's
+  // registered reflection service
+  // (/root/reference/tracker/cmd/tracker/main.go:135).  The set is parsed
+  // once here with the same hand-rolled varint walkers the daemon already
+  // uses for its wire writer — no protobuf runtime dependency.
+  void set_reflection_descriptor_set(const std::string &fds_bytes);
+
   int start();  // returns bound port, or -1
   void stop();
 
   int port() const { return port_; }
   uint64_t subscribers() const { return subscribers_.load(); }
 
+  // Parsed form of one descriptor-set file (public for the parser's tests).
+  struct RefFile {
+    std::string name;                   // e.g. "trace.proto"
+    std::string pkg;                    // e.g. "nerrf.trace"
+    std::string bytes;                  // serialized FileDescriptorProto
+    std::vector<std::string> deps;      // imported file names
+    std::vector<std::string> symbols;   // fully-qualified top-level symbols
+    std::vector<std::string> services;  // fully-qualified service names
+  };
+
  private:
   void accept_loop();
   void handle_conn(int fd);
+  std::string reflect_reply(const std::string &request) const;
 
   std::string addr_;
   std::string path_;
+  std::vector<RefFile> reflection_files_;
   std::string uds_path_;
   Subscribe subscribe_;
   OnPeer on_peer_;

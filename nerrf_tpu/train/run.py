@@ -237,11 +237,20 @@ def main(argv=None) -> int:
         # single-controller path: same probe-or-degrade guard as the CLI —
         # a wedged accelerator tunnel otherwise hangs the first traced op
         # indefinitely (observed live).  Only 'cpu' is probe-free (it
-        # cannot hang on a dead tunnel — bench.py's rule); a *forced
-        # accelerator* platform still probes.  Multi-host runs skip it:
-        # the coordinator barrier has its own timeout and a CPU fallback
-        # would silently split the cluster.
-        ensure_backend_or_cpu("train-run", timeout_sec=150.0)
+        # cannot hang on a dead tunnel — bench.py's rule).  Multi-host
+        # runs skip it: the coordinator barrier has its own timeout and a
+        # CPU fallback would silently split the cluster.
+        ok, detail = ensure_backend_or_cpu("train-run", timeout_sec=150.0)
+        if not ok and args.platform:
+            # the operator FORCED an accelerator; silently pinning a
+            # flagship run to CPU burns the whole queue-timeout budget
+            # with only a stderr line as evidence (r4 advisor) — mirror
+            # run_recovery_bench's "explicit choice keeps the hard
+            # failure" rule and fail fast so the watcher retries instead
+            raise SystemExit(
+                f"train-run: --platform {args.platform} was forced but "
+                f"the backend probe failed ({detail}); refusing to "
+                f"degrade a forced-accelerator run to CPU")
     from nerrf_tpu.parallel import init_distributed
 
     if init_distributed():

@@ -1,23 +1,26 @@
 #!/bin/bash
-# Round-4 chip-work queue: waits for the TPU tunnel, then runs the offline
-# artifact producers serially.  Order matters — training first (its
-# checkpoint feeds the adversarial eval), then the evals, then the
-# benchmark of record last so it exercises warm compilation caches.
+# Round-5 chip-work queue: waits for the TPU tunnel, then runs the offline
+# artifact producers serially (single-core host: nothing here overlaps).
+# Order matters — training first (its checkpoint feeds the adversarial
+# eval), then the evals, then the benchmark of record last so it exercises
+# warm compilation caches.
 #
-#   1. joint-100h training on the r4 corpus        → runs/joint-100h
-#   2. adversarial eval vs that checkpoint         → adversarial_r4.json
-#   3. graph capacity + Pallas crossover           → graph_capacity.json
-#   4. planner throughput probe                    → mcts_tpu.log
-#   5. recovery benches (device planner)           → m{0,1}_recovery.json
-#   6. stream detector quality + calibration       → stream_probe_tpu.json
-#   7. bench.py smoke (MFU + compile-time fields)  → /tmp/bench_smoke.json
+#   1/9. joint-100h training on the r4+ corpus     → runs/joint-100h
+#   2/9. joint-dense training (4096n/8192e bucket) → runs/joint-dense
+#   3/9. adversarial eval vs the 100h checkpoint   → adversarial_r5.json
+#   4/9. graph capacity + Pallas crossover         → graph_capacity.json
+#   5/9. planner throughput probe                  → mcts_tpu.log
+#   6/9. recovery benches (device planner)         → m{0,1}_recovery.json
+#   7/9. stream detector quality + calibration     → stream_probe_tpu.json
+#   8/9. chip-gated compiled-kernel test           → pallas_tpu.log
+#   9/9. bench.py smoke (MFU + 4096-bucket leg)    → /tmp/bench_smoke.json
 #
 # Safe to re-run; each step is idempotent or overwrite-only.  Nothing here
 # git-commits — artifacts are reviewed and committed by hand.
 # Logs: /tmp/tpu_queue.log + per-step logs in /tmp.
 cd "$(dirname "$0")/.."
 log() { echo "[queue $(date +%H:%M:%S)] $*" >> /tmp/tpu_queue.log; }
-log "watcher started (r4)"
+log "watcher started (r5)"
 # the gate must exercise the full enumerate->compile->execute path: the
 # relay has been seen half-up (enumeration answering, remote_compile
 # refusing), which passes an enumeration-only check and then wedges the
@@ -35,8 +38,7 @@ sys.exit(0 if ok and detail.startswith('tpu') else 1)
 }
 wait_for_tpu() {
   # probe attempts are the round's evidence when the tunnel never comes
-  # up (VERDICT r3 item 1: "check in the watcher's attempt log as the
-  # artifact and say so") — one line per failed probe, timestamped
+  # up (VERDICT r3 item 1) — one line per failed probe, timestamped
   local n=0
   while ! tpu_ok; do
     n=$((n + 1))
@@ -46,10 +48,9 @@ wait_for_tpu() {
   log "TPU is up (fresh compile path verified after $n failed probes)"
 }
 wait_for_tpu
-# require the REGENERATED r4 corpus: auto-fit zero-drop manifest AND the
-# new stealth attack variants present — training the flagship on the r3
-# corpus would leave it blind to exactly the scenarios the r4 adversarial
-# eval measures (VERDICT r3 item 3)
+# require the regenerated zero-drop corpus with the stealth variants:
+# training the flagship on an older corpus would leave it blind to exactly
+# the scenarios the adversarial eval measures (VERDICT r3 item 3)
 while ! python - <<'EOF' 2>/dev/null
 import json, sys
 m = json.load(open("datasets/corpus100/manifest.json"))
@@ -60,9 +61,9 @@ sys.exit(0 if m.get("complete") and m.get("auto_fit")
          and sc.get("benign-atomic-rewrite", 0) > 0 else 1)
 EOF
 do
-  log "waiting for the r4 zero-drop corpus100 (stealth variants)"; sleep 60
+  log "waiting for the zero-drop corpus100 (stealth variants)"; sleep 60
 done
-log "1/7 joint-100h training"
+log "1/9 joint-100h training"
 # the corpus is ~10 GB and rotates shards through the chip each epoch; over
 # a ~0.5 GB/s tunnel the wall clock is transfer-bound, so budget generously
 # and rely on resume-from-checkpoint for the retry.  The tunnel has twice
@@ -79,29 +80,43 @@ for attempt in 1 2 3; do
 done
 if [ -f runs/joint-100h/metrics.json ]; then
   mkdir -p benchmarks/results
-  cp runs/joint-100h/metrics.json benchmarks/results/joint100h_r4.json
+  cp runs/joint-100h/metrics.json benchmarks/results/joint100h_r5.json
   log "copied joint100h artifact"
 fi
-log "2/7 adversarial eval (flagship checkpoint when present)"
+log "2/9 joint-dense training (deployed 4096n/8192e bucket)"
+for attempt in 1 2; do
+  wait_for_tpu
+  timeout 7200 python -m nerrf_tpu.train.run --experiment joint-dense \
+    --out runs/joint-dense --ckpt-every 1000 > /tmp/jointdense.log 2>&1
+  rc=$?
+  log "joint-dense attempt $attempt rc=$rc"
+  [ $rc -eq 0 ] && break
+done
+if [ -f runs/joint-dense/metrics.json ]; then
+  mkdir -p benchmarks/results
+  cp runs/joint-dense/metrics.json benchmarks/results/joint_dense_r5.json
+  log "copied joint-dense artifact"
+fi
+log "3/9 adversarial eval (flagship checkpoint when present)"
 wait_for_tpu
 if [ -f runs/joint-100h/model/model_config.json ]; then
   timeout 3600 python benchmarks/run_adversarial_eval.py \
-    --out benchmarks/results/adversarial_r4.json \
-    --model-dir runs/joint-100h/model > /tmp/adv_r4.log 2>&1
+    --out benchmarks/results/adversarial_r5.json \
+    --model-dir runs/joint-100h/model > /tmp/adv_r5.log 2>&1
 else
   timeout 3600 python benchmarks/run_adversarial_eval.py \
-    --out benchmarks/results/adversarial_r4.json > /tmp/adv_r4.log 2>&1
+    --out benchmarks/results/adversarial_r5.json > /tmp/adv_r5.log 2>&1
 fi
 log "adversarial rc=$?"
-log "3/7 graph capacity (pallas crossover)"
+log "4/9 graph capacity (pallas crossover)"
 wait_for_tpu
 timeout 1800 python benchmarks/run_graph_capacity.py \
   --out benchmarks/results/graph_capacity.json > /tmp/graphcap.log 2>&1
 log "graphcap rc=$?"
-log "4/7 planner throughput probe"
+log "5/9 planner throughput probe"
 timeout 1200 python benchmarks/run_planner_probe.py > /tmp/mcts_tpu.log 2>&1
 log "mcts rc=$?"
-log "5/7 recovery benches (device planner in the KPI path)"
+log "6/9 recovery benches (device planner in the KPI path)"
 wait_for_tpu
 timeout 1800 python benchmarks/run_recovery_bench.py --scale m0 \
   --out benchmarks/results/m0_recovery.json > /tmp/recovery_m0.log 2>&1
@@ -109,17 +124,17 @@ log "m0 recovery rc=$?"
 timeout 1800 python benchmarks/run_recovery_bench.py --scale m1 \
   --out benchmarks/results/m1_recovery.json > /tmp/recovery_m1.log 2>&1
 log "m1 recovery rc=$?"
-log "6/8 stream detector quality + calibration on chip"
+log "7/9 stream detector quality + calibration on chip"
 wait_for_tpu
 timeout 2400 python benchmarks/run_stream_eval.py --steps 1500 \
   --out benchmarks/results/stream_probe_tpu.json > /tmp/stream_tpu.log 2>&1
 log "stream quality rc=$?"
-log "7/8 chip-gated compiled-kernel test"
+log "8/9 chip-gated compiled-kernel test"
 wait_for_tpu
 NERRF_TEST_REAL_BACKEND=1 timeout 1200 python -m pytest \
   tests/test_pallas_ops.py -q -k compiled_on_tpu > /tmp/pallas_tpu.log 2>&1
 log "pallas chip test rc=$?"
-log "8/8 bench.py smoke (validates the driver's benchmark of record: MFU + compile fields)"
+log "9/9 bench.py smoke (validates the driver's benchmark of record: MFU + 4096-bucket leg)"
 wait_for_tpu
 timeout 3600 python bench.py > /tmp/bench_smoke.json 2> /tmp/bench_smoke.log
 log "bench rc=$?"

@@ -18,8 +18,8 @@ from nerrf_tpu.train.loop import TrainConfig
 
 def test_registry_matches_baseline_configs():
     assert set(EXPERIMENTS) == {
-        "toy-graphsage", "lstm-impact", "joint-100h", "mcts-lockbit",
-        "multihost-online",
+        "toy-graphsage", "lstm-impact", "joint-100h", "joint-dense",
+        "mcts-lockbit", "multihost-online",
     }
 
 

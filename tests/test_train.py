@@ -162,6 +162,41 @@ def test_checkpoint_calibration_roundtrip(tmp_path):
     assert set(det.flagged_files(0.5)) == {"/a", "/b"}
 
 
+def test_checkpoint_feature_layout_gate(tmp_path):
+    """NODE_FEATURE_DIM moved 22→24 in r4 and a stale checkpoint only failed
+    at apply time with an opaque Flax shape error (r4 advisor, medium): the
+    sidecar now records the feature layout and load_checkpoint fails FAST
+    with a clear retrain message on mismatch or on an unstamped sidecar."""
+    import json
+
+    import numpy as np
+    import pytest
+
+    from nerrf_tpu.models import GraphSAGEConfig, LSTMConfig
+    from nerrf_tpu.models import JointConfig as JC
+    from nerrf_tpu.train.checkpoint import load_checkpoint, save_checkpoint
+
+    cfg = JC(gnn=GraphSAGEConfig(hidden=8, num_layers=1),
+             lstm=LSTMConfig(hidden=8, num_layers=1))
+    params = {"w": np.ones((2, 2), np.float32)}
+    save_checkpoint(tmp_path / "m", params, cfg)
+    sidecar = tmp_path / "m" / "model_config.json"
+    meta = json.loads(sidecar.read_text())
+    assert meta["features"]["node"] == 24  # current layout stamped
+
+    load_checkpoint(tmp_path / "m")  # current layout loads fine
+
+    meta["features"]["node"] = 22  # a pre-r4 checkpoint's layout
+    sidecar.write_text(json.dumps(meta))
+    with pytest.raises(ValueError, match="retrain: feature layout changed"):
+        load_checkpoint(tmp_path / "m")
+
+    del meta["features"]  # a checkpoint predating the versioned sidecar
+    sidecar.write_text(json.dumps(meta))
+    with pytest.raises(ValueError, match="predates feature-layout"):
+        load_checkpoint(tmp_path / "m")
+
+
 def test_evaluate_resident_matches_host_slicing(small_dataset):
     """Device-resident eval (one upload + index-driven batches) must produce
     identical metrics to the per-batch host-slicing path, including the
