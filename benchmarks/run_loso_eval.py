@@ -1,0 +1,180 @@
+#!/usr/bin/env python3
+"""Leave-one-scenario-out generalization eval (VERDICT r4 weak #3 / next #2).
+
+The adversarial sweep's clean stealth wins are measured in-distribution:
+the training corpus contains every scenario family (seeds held out,
+generators not), so they show the model beats the heuristic, not that it
+detects UNSEEN attack mechanics.  This harness measures exactly that: for
+each stealth family, train a probe-scale detector on a corpus from which
+that family's GENERATOR is excluded (`make_corpus(exclude_scenarios=…)`),
+calibrate its operating threshold without the family
+(`calibrate_file_thresholds(exclude_scenarios=…)` — a cut picked on
+held-out-family victims would leak), then measure file-level detection on
+fresh traces of the excluded family at that cut.
+
+The honest deliverable is the per-family out-of-distribution detection
+rate next to the in-distribution one — including families where OOD
+detection DROPS.  A model that detects inplace-stealth only after training
+on inplace-stealth is still useful (the corpus ships the family), but the
+README claim must say which is which.
+
+Reference hook: the reference's detection plan is indicator rules
+(`/root/reference/docs/content/docs/detection/threat-model.mdx:275-319`);
+its heuristics are definitionally 0% OOD on these families (they carry no
+rename/extension/note indicators at all) — that column is the baseline.
+
+Usage:
+  python benchmarks/run_loso_eval.py --out benchmarks/results/loso_eval.json
+  ... --steps 500 --train-traces 16 --eval-traces 6 [--families inplace-stealth ...]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+sys.path.insert(0, str(Path(__file__).resolve().parent))
+
+
+def _log(msg):
+    print(f"[loso] {msg}", file=sys.stderr, flush=True)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--out", default="benchmarks/results/loso_eval.json")
+    ap.add_argument("--steps", type=int, default=500)
+    ap.add_argument("--train-traces", type=int, default=16)
+    ap.add_argument("--eval-traces", type=int, default=6)
+    ap.add_argument("--seed", type=int, default=303)
+    ap.add_argument("--families", nargs="*", default=None,
+                    help="subset of stealth families (default: all four)")
+    ap.add_argument("--platform", default=None,
+                    help="force a JAX platform before backend init")
+    args = ap.parse_args(argv)
+
+    from nerrf_tpu.utils import enable_compilation_cache
+
+    enable_compilation_cache()
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+
+    from run_adversarial_eval import _file_metrics, _scenario_traces
+
+    from nerrf_tpu.data.synth import STEALTH_SCENARIOS, make_corpus
+    from nerrf_tpu.models import (
+        GraphSAGEConfig,
+        JointConfig,
+        LSTMConfig,
+        NerrfNet,
+    )
+    from nerrf_tpu.pipeline import (
+        calibrate_file_thresholds,
+        heuristic_detect,
+        model_detect,
+    )
+    from nerrf_tpu.train import TrainConfig, build_dataset
+    from nerrf_tpu.train.data import fit_dataset_config
+    from nerrf_tpu.train.loop import train_nerrfnet
+
+    t0 = time.time()
+    backend = jax.default_backend()
+    families = args.families or sorted(STEALTH_SCENARIOS)
+    bad = set(families) - STEALTH_SCENARIOS
+    if bad:
+        ap.error(f"not stealth families: {sorted(bad)}")
+    _log(f"backend={backend} families={families}")
+
+    # probe scale — the same small-joint architecture as the
+    # probe-corpus-cpu checkpoint; LOSO measures a generalization DELTA,
+    # which probe scale resolves (VERDICT r4 next #2: "probe scale is fine")
+    model_cfg = JointConfig(
+        gnn=GraphSAGEConfig(hidden=64, num_layers=8),
+        lstm=LSTMConfig(hidden=64, num_layers=1),
+    )
+
+    report = {"backend": backend, "steps": args.steps,
+              "train_traces": args.train_traces,
+              "eval_traces": args.eval_traces,
+              "model": "small-joint 64h (probe scale)",
+              "families": {}}
+    for family in families:
+        _log(f"=== hold out {family}: corpus without its generator")
+        corpus = make_corpus(
+            args.train_traces, attack_fraction=0.5,
+            base_seed=args.seed, duration_sec=180.0,
+            num_target_files=24, benign_rate_hz=40.0,
+            hard_scenarios=True, exclude_scenarios=frozenset({family}),
+        )
+        cfg = TrainConfig(model=model_cfg, batch_size=8,
+                          num_steps=args.steps,
+                          eval_every=max(100, args.steps),
+                          seed=args.seed)
+        res = train_nerrfnet(build_dataset(corpus, fit_dataset_config(corpus)),
+                             cfg=cfg, log=_log)
+        params = res.state.params
+        model = NerrfNet(cfg.model)
+        cals = calibrate_file_thresholds(
+            params, model, exclude_scenarios=frozenset({family}), log=_log)
+        threshold = cals["max"].threshold if cals.get("max") else None
+        _log(f"  calibrated cut (family excluded): {threshold}")
+
+        # fresh traces of the EXCLUDED family — the model has never seen
+        # this generator's mechanics, the threshold never saw its scores
+        traces = _scenario_traces(family, args.eval_traces, args.seed + 5000)
+        detections = [model_detect(tr, params, model, threshold=threshold)
+                      for tr in traces]
+        ood = _file_metrics(list(zip(traces, detections)), lambda td: td[1])
+        heur = _file_metrics([(tr, None) for tr in traces],
+                             lambda td: heuristic_detect(td[0]))
+        # benign hard negatives at the same cut: OOD detection bought by a
+        # cut low enough to also flag benign churn is not a win
+        fp_entry = {}
+        for neg in ("benign-mass-rename", "benign-atomic-rewrite"):
+            ntraces = _scenario_traces(neg, 2, args.seed + 6000)
+            ndet = [model_detect(tr, params, model, threshold=threshold)
+                    for tr in ntraces]
+            m = _file_metrics(list(zip(ntraces, ndet)), lambda td: td[1])
+            fp_entry[neg] = m["fp_undo_rate"]
+        entry = {
+            "ood_detection_rate": ood["detection_rate"],
+            "ood_fp_undo_rate": ood["fp_undo_rate"],
+            "heuristic_detection_rate": heur["detection_rate"],
+            "threshold": round(threshold, 4) if threshold else None,
+            "benign_fp_undo_at_cut": fp_entry,
+            "files_attacked": ood["files_attacked"],
+        }
+        report["families"][family] = entry
+        _log(f"  {family}: {json.dumps(entry)}")
+
+    rates = [e["ood_detection_rate"] or 0.0
+             for e in report["families"].values()]
+    report["summary"] = {
+        "ood_detection_min": round(min(rates), 4),
+        "ood_detection_mean": round(sum(rates) / len(rates), 4),
+        "families_generalized": sorted(
+            f for f, e in report["families"].items()
+            if (e["ood_detection_rate"] or 0.0) >= 0.95
+            and e["ood_fp_undo_rate"] < 0.05),
+        "note": ("in-distribution numbers for the same families live in "
+                 "the adversarial artifact (benchmarks/results/"
+                 "adversarial_probe_cpu.json) — compare before claiming "
+                 "generalization"),
+    }
+    report["provenance"] = "python benchmarks/run_loso_eval.py"
+    report["wall_seconds"] = round(time.time() - t0, 1)
+    out = Path(args.out)
+    out.parent.mkdir(parents=True, exist_ok=True)
+    out.write_text(json.dumps(report, indent=2) + "\n")
+    print(json.dumps(report["summary"]))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

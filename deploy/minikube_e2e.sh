@@ -19,9 +19,10 @@
 #   2. build + load the 2-stage image (deploy/Dockerfile)
 #   3. render the chart -> kubectl apply (namespace nerrf)
 #   4. victim pod: nerrf simulate (m1-scale real-file attack) on an emptyDir
-#   5. wait for the tracker DaemonSet to go Ready, stream 60s of events
-#   6. nerrf undo --dry-run against the captured store; save artifacts
-#      under benchmarks/results/minikube_e2e/
+#   5. tracker DaemonSet Ready; ingest 60s of its live stream into a store
+#      on the victim pod (wire capture)
+#   6. export the wire store and run nerrf undo --dry-run ON THE WIRE COPY
+#      (--trace); save artifacts under benchmarks/results/minikube_e2e/
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -100,7 +101,7 @@ kubectl -n nerrf delete pod nerrf-victim --ignore-not-found
 kubectl -n nerrf run nerrf-victim --image="$IMG" --restart=Never \
   --overrides='{"spec":{"containers":[{"name":"nerrf-victim","image":"nerrf/nerrf-tpu:e2e","command":["sh","-c","python -m nerrf_tpu.cli simulate --incident /app/uploads/incident --files 45 && sleep 1800"],"volumeMounts":[{"name":"uploads","mountPath":"/app/uploads"}]}],"volumes":[{"name":"uploads","emptyDir":{"sizeLimit":"2Gi"}}]}}'
 
-# --- 5. tracker ready + capture -------------------------------------------
+# --- 5. tracker ready + wire capture INTO the victim pod -------------------
 log "waiting for tracker DaemonSet"
 kubectl -n nerrf rollout status daemonset/nerrf-tracker --timeout=300s
 kubectl -n nerrf wait --for=condition=Ready pod/nerrf-victim \
@@ -114,18 +115,31 @@ for _ in $(seq 60); do
 done
 TRACKER=$(kubectl -n nerrf get pods -l app.kubernetes.io/component=tracker \
   -o jsonpath='{.items[0].metadata.name}')
-log "capturing 60s of events from $TRACKER"
 kubectl -n nerrf logs "$TRACKER" --tail=200 > "$OUT/tracker.log" || true
-kubectl -n nerrf exec "$TRACKER" -- \
-  python -m nerrf_tpu.cli ingest --target 127.0.0.1:50051 \
-  --store-dir /var/lib/nerrf/store --timeout 60 > "$OUT/ingest.json" || true
+# drain the tracker's live stream into a store ON THE VICTIM POD, so the
+# undo below can detect on daemon-delivered events (the same local-vs-wire
+# discipline as benchmarks/run_e2e_daemon.py)
+log "ingesting 60s of the tracker stream into the victim pod"
+kubectl -n nerrf exec nerrf-victim -- \
+  python -m nerrf_tpu.cli ingest \
+  --target nerrf-tracker.nerrf.svc:50051 \
+  --store-dir /app/uploads/wire_store --metrics-port -1 \
+  --timeout 60 > "$OUT/ingest.json" || true
 
-# --- 6. detect + gated undo ------------------------------------------------
-# the victim's incident dir (snapshot + trace + attacked files) is on the
-# victim pod's emptyDir; undo runs against it dry-run and prints its plan
-log "detect + dry-run undo against the victim incident"
+# --- 6. detect + gated undo on the WIRE copy -------------------------------
+log "export wire store -> detect + dry-run undo"
+kubectl -n nerrf exec nerrf-victim -- python -c '
+import sys; sys.path.insert(0, "/app")
+from nerrf_tpu.graph.store import TraceStore
+from nerrf_tpu.schema.events import events_to_jsonl
+with TraceStore("/app/uploads/wire_store") as st:
+    ev, strings = st.query(0, 2**63 - 1)
+open("/app/uploads/wire_trace.jsonl", "w").write(events_to_jsonl(ev, strings))
+print("wire events:", int(ev.num_valid))
+' > "$OUT/wire_export.log" || true
 kubectl -n nerrf exec nerrf-victim -- \
   python -m nerrf_tpu.cli undo --incident /app/uploads/incident \
+  --trace /app/uploads/wire_trace.jsonl \
   --dry-run > "$OUT/undo_dryrun.json" || true
 kubectl -n nerrf exec nerrf-victim -- \
   python -m nerrf_tpu.cli status --incident /app/uploads/incident \

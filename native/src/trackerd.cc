@@ -201,6 +201,102 @@ void on_event(void *user, const struct nerrf_event_record *rec) {
 std::atomic<bool> g_stop{false};
 void on_signal(int) { g_stop.store(true); }
 
+// ---- trace replay (--replay) ----------------------------------------------
+// Stream a captured incident trace (the ND-JSON the Python side writes,
+// schema/events.py events_to_jsonl) through the SAME encode→batch→broadcast
+// path live capture uses.  This is how the end-to-end artifact gets a REAL
+// incident through the real wire on hosts without CAP_BPF: `nerrf simulate`
+// attacks real files and writes the trace; the daemon replays it; the
+// detector consumes what crossed HTTP/2 — not the file on disk.
+
+// Extract `"key": value` from one flat JSON line (our own writer: json.dumps
+// with sort_keys, ": " separators, printable-sanitized strings).
+bool json_field(const std::string &line, const char *key, std::string *out) {
+  std::string pat = std::string("\"") + key + "\": ";
+  size_t p = line.find(pat);
+  if (p == std::string::npos) return false;
+  p += pat.size();
+  if (p >= line.size()) return false;
+  if (line[p] == '"') {
+    ++p;
+    std::string s;
+    while (p < line.size() && line[p] != '"') {
+      if (line[p] == '\\' && p + 1 < line.size()) ++p;  // \" \\ escapes
+      s.push_back(line[p++]);
+    }
+    *out = s;
+  } else {
+    size_t e = line.find_first_of(",}", p);
+    *out = line.substr(p, e == std::string::npos ? e : e - p);
+  }
+  return true;
+}
+
+int64_t json_int(const std::string &line, const char *key) {
+  std::string v;
+  if (!json_field(line, key, &v)) return 0;
+  return atoll(v.c_str());
+}
+
+// "2026-08-01T05:49:51.797079621Z" → epoch ns (0 on parse failure)
+int64_t parse_rfc3339_ns(const std::string &s) {
+  struct tm tm;
+  memset(&tm, 0, sizeof(tm));
+  const char *rest = strptime(s.c_str(), "%Y-%m-%dT%H:%M:%S", &tm);
+  if (!rest) return 0;
+  int64_t ns = static_cast<int64_t>(timegm(&tm)) * 1000000000ll;
+  if (*rest == '.') {
+    ++rest;
+    int64_t frac = 0, scale = 100000000;
+    while (*rest >= '0' && *rest <= '9' && scale > 0) {
+      frac += (*rest++ - '0') * scale;
+      scale /= 10;
+    }
+    ns += frac;
+  }
+  return ns;
+}
+
+uint32_t syscall_id_of(const std::string &name) {
+  for (uint32_t i = 0; i <= NERRF_SC_OTHER; ++i)
+    if (name == syscall_name(i)) return i;
+  return NERRF_SC_OTHER;
+}
+
+bool load_replay(const std::string &path,
+                 std::vector<nerrf_event_record> *out) {
+  FILE *f = fopen(path.c_str(), "r");
+  if (!f) return false;
+  char *buf = nullptr;
+  size_t cap = 0;
+  ssize_t n;
+  while ((n = getline(&buf, &cap, f)) > 0) {
+    std::string line(buf, static_cast<size_t>(n));
+    std::string ts, comm, sc, p1, p2;
+    if (!json_field(line, "timestamp", &ts) ||
+        !json_field(line, "syscall", &sc))
+      continue;
+    nerrf_event_record rec;
+    memset(&rec, 0, sizeof(rec));
+    rec.ts_ns = static_cast<uint64_t>(parse_rfc3339_ns(ts));
+    rec.pid = static_cast<uint32_t>(json_int(line, "pid"));
+    rec.tid = static_cast<uint32_t>(json_int(line, "tid"));
+    rec.syscall_id = syscall_id_of(sc);
+    rec.ret_val = json_int(line, "ret_val");
+    rec.bytes = static_cast<uint64_t>(json_int(line, "bytes"));
+    if (json_field(line, "comm", &comm))
+      snprintf(rec.comm, sizeof(rec.comm), "%s", comm.c_str());
+    if (json_field(line, "path", &p1))
+      snprintf(rec.path, sizeof(rec.path), "%s", p1.c_str());
+    if (json_field(line, "new_path", &p2) && !p2.empty())
+      snprintf(rec.new_path, sizeof(rec.new_path), "%s", p2.c_str());
+    out->push_back(rec);
+  }
+  free(buf);
+  fclose(f);
+  return !out->empty();
+}
+
 }  // namespace
 
 int main(int argc, char **argv) {
@@ -212,6 +308,8 @@ int main(int argc, char **argv) {
   bool capture_self = false;
   bool probe_only = false;
   int synthetic_hz = 0;
+  std::string replay_path;
+  int replay_hz = 500;
 
   for (int i = 1; i < argc; ++i) {
     std::string a = argv[i];
@@ -225,18 +323,34 @@ int main(int argc, char **argv) {
     else if (a == "--capture-self") capture_self = true;
     else if (a == "--probe") probe_only = true;
     else if (a == "--synthetic") synthetic_hz = atoi(next());
+    else if (a == "--replay") replay_path = next();
+    else if (a == "--replay-rate") replay_hz = atoi(next());
     else {
       fprintf(stderr, "usage: %s [--listen H:P] [--ringbuf B] [--batch N] "
                       "[--max-seconds S] [--capture-self] [--probe] "
-                      "[--synthetic HZ]\n",
+                      "[--synthetic HZ] [--replay TRACE.jsonl] "
+                      "[--replay-rate HZ]\n",
               argv[0]);
       return 1;
     }
   }
 
+  std::vector<nerrf_event_record> replay;
+  if (!replay_path.empty()) {
+    if (!load_replay(replay_path, &replay)) {
+      fprintf(stderr, "[trackerd] replay load failed: %s\n",
+              replay_path.c_str());
+      return 1;
+    }
+    if (probe_only) {
+      printf("replay ok (%zu events)\n", replay.size());
+      return 0;
+    }
+  }
+
   char err[1024] = {0};
   nerrf_capture *cap = nullptr;
-  if (synthetic_hz <= 0) {
+  if (synthetic_hz <= 0 && replay.empty()) {
     int st = nerrf_capture_probe(err, sizeof(err));
     if (st != NERRF_CAPTURE_OK) {
       fprintf(stderr, "[trackerd] capture unavailable: %s\n", err);
@@ -279,7 +393,9 @@ int main(int argc, char **argv) {
   // resolved port in the log line: clients of `--listen host:0` (tests
   // avoiding fixed-port collisions) parse it from here
   fprintf(stderr, "[trackerd] %s; serving StreamEvents on %s (port %d)\n",
-          cap ? "capturing" : "synthetic source", listen.c_str(), port);
+          cap ? "capturing"
+              : !replay.empty() ? "replay source" : "synthetic source",
+          listen.c_str(), port);
   if (listen.rfind("unix:", 0) != 0)
     fprintf(stderr,
             "[trackerd] note: TCP clients cannot be pid-excluded "
@@ -302,9 +418,46 @@ int main(int argc, char **argv) {
   time_t start = time(nullptr);
   time_t last_log = start;
   uint64_t synth_seq = 0;
+  size_t replay_pos = 0;
+  time_t replay_done_at = 0;
   while (!g_stop.load()) {
     if (cap) {
       nerrf_capture_poll(cap, 100, on_event, &cx);
+    } else if (!replay.empty()) {
+      // replayed events carry ABSOLUTE wall-clock timestamps from the
+      // incident (the monotonic→wall correction must not re-shift them)
+      cx.boot_wall_ns = 0;
+      if (replay_pos == 0 && server.subscribers() == 0) {
+        // hold the replay for the first subscriber: a short trace at
+        // replay-rate outruns any client's startup, and events broadcast
+        // to zero queues are simply gone (observed: 172/172 lost to a
+        // grpcio client that took 2 s to connect)
+        struct timespec nap = {0, 50 * 1000000};
+        nanosleep(&nap, nullptr);
+        if (max_seconds > 0 && time(nullptr) - start >= max_seconds) break;
+        continue;
+      }
+      if (replay_pos < replay.size()) {
+        int burst = replay_hz / 20 + 1;  // 50 ms cadence, like synthetic
+        for (int k = 0; k < burst && replay_pos < replay.size(); ++k)
+          on_event(&cx, &replay[replay_pos++]);
+        if (replay_pos >= replay.size()) {
+          replay_done_at = time(nullptr);
+          fprintf(stderr, "[trackerd] replay complete: %zu events\n",
+                  replay.size());
+          flush_batch(&cx);
+          // closing the source queues lets the H2 write pass send
+          // grpc-status 0 trailers once each subscriber drains — clients
+          // get a clean end-of-stream instead of a mid-stream RST
+          bcast.close_all();
+        }
+      } else {
+        if (server.subscribers() == 0 ||
+            time(nullptr) - replay_done_at >= 10)
+          break;
+      }
+      struct timespec nap = {0, 50 * 1000000};
+      nanosleep(&nap, nullptr);
     } else {
       // synthetic workload: ~synthetic_hz events/s of the canonical
       // openat→write→rename triple, through the SAME encode path live

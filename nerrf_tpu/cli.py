@@ -180,7 +180,12 @@ def cmd_undo(args) -> int:
     victim = Path(meta["target"])
     t_start = time.perf_counter()
 
-    trace = load_trace_jsonl(inc / "trace.jsonl")
+    # --trace: detect on a trace OTHER than the incident's own file — the
+    # end-to-end wire artifact points this at the copy that crossed the
+    # native daemon's HTTP/2 stream, so detection consumes daemon-delivered
+    # bytes, not the simulator's local file
+    trace = load_trace_jsonl(Path(args.trace) if args.trace
+                             else inc / "trace.jsonl")
     store = SnapshotStore(inc / "store")
     manifest = store.load_manifest(meta["snapshot_id"])
 
@@ -440,6 +445,9 @@ def main(argv=None) -> int:
                         "trips); auto (default) = device when a chip is up "
                         "— plan time dominates MTTR, so the chip is the "
                         "KPI path")
+    p.add_argument("--trace", default=None,
+                   help="detect on this trace file instead of the "
+                        "incident's own trace.jsonl (e2e wire artifact)")
     p.add_argument("--dry-run", action="store_true")
     p.add_argument("--no-gate", action="store_true")
     p.add_argument("--no-probe", action="store_true",

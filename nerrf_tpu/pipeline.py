@@ -365,6 +365,7 @@ def calibrate_file_thresholds(
     target_precision: float = 0.98,
     min_recall: float = 0.5,
     aggs: tuple = ("max", "robust"),
+    exclude_scenarios: frozenset = frozenset(),
     log=None,
 ) -> Dict[str, Calibration]:
     """Held-out calibration of the file detector's operating threshold, at
@@ -436,6 +437,10 @@ def calibrate_file_thresholds(
         SimConfig(attack=False, scenario="benign-atomic-rewrite",
                   seed=base_seed + 7005, **base),
     ]
+    # leave-one-scenario-out runs must not pick their cut on held-out-family
+    # victims — that would leak the family's score distribution into the
+    # operating point the OOD eval then measures at
+    cfgs = [c for c in cfgs if c.scenario not in exclude_scenarios]
     incidents = []  # (DetectionResult, attack-touched set) per trace
     for i, cfg in enumerate(cfgs):
         tr = simulate_trace(cfg, name=f"calib-{i}-{cfg.scenario}")

@@ -241,16 +241,21 @@ def main(argv=None) -> int:
         # runs skip it: the coordinator barrier has its own timeout and a
         # CPU fallback would silently split the cluster.
         ok, detail = ensure_backend_or_cpu("train-run", timeout_sec=150.0)
-        if not ok and args.platform:
-            # the operator FORCED an accelerator; silently pinning a
-            # flagship run to CPU burns the whole queue-timeout budget
-            # with only a stderr line as evidence (r4 advisor) — mirror
-            # run_recovery_bench's "explicit choice keeps the hard
-            # failure" rule and fail fast so the watcher retries instead
+        if not ok and (args.platform or
+                       os.environ.get("NERRF_REQUIRE_ACCEL") == "1"):
+            # the operator FORCED an accelerator (--platform, or the chip
+            # queue's NERRF_REQUIRE_ACCEL=1 — the queue can't name the
+            # platform portably, but its runs are chip runs by contract);
+            # silently pinning a flagship run to CPU burns the whole
+            # queue-timeout budget with only a stderr line as evidence
+            # (r4 advisor) — mirror run_recovery_bench's "explicit choice
+            # keeps the hard failure" rule and fail fast so the watcher
+            # goes back to waiting instead
             raise SystemExit(
-                f"train-run: --platform {args.platform} was forced but "
-                f"the backend probe failed ({detail}); refusing to "
-                f"degrade a forced-accelerator run to CPU")
+                f"train-run: an accelerator was required "
+                f"({'--platform ' + args.platform if args.platform else 'NERRF_REQUIRE_ACCEL=1'}) "
+                f"but the backend probe failed ({detail}); refusing to "
+                f"degrade to CPU")
     from nerrf_tpu.parallel import init_distributed
 
     if init_distributed():

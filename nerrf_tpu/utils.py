@@ -178,20 +178,29 @@ def enable_compilation_cache() -> None:
         # round's host loading on a narrower Xeon).  A per-fingerprint
         # subdir means a moved workspace recompiles once instead of
         # gambling on foreign executables.
-        flags = ""
+        flags = model = ""
         try:
             with open("/proc/cpuinfo") as f:
                 for line in f:
                     # x86 spells it "flags", aarch64 "Features" — missing
                     # the latter would collapse all ARM hosts into one
                     # namespace and resurrect the foreign-AOT risk there
-                    if line.startswith(("flags", "Features")):
+                    if not flags and line.startswith(("flags", "Features")):
                         flags = " ".join(sorted(line.split(":", 1)[1].split()))
+                    # the microarch name must join the fingerprint: two
+                    # Xeons with IDENTICAL flags lists still get different
+                    # LLVM target-cpu tuning (+prefer-no-gather et al.),
+                    # and flag-only namespacing was observed live loading
+                    # those foreign AOT entries with machine-type warnings
+                    if not model and line.startswith(("model name",
+                                                     "CPU part")):
+                        model = line.split(":", 1)[1].strip()
+                    if flags and model:
                         break
         except OSError:
             pass
         host = hashlib.sha256(
-            f"{platform.machine()}|{flags}".encode()).hexdigest()[:12]
+            f"{platform.machine()}|{model}|{flags}".encode()).hexdigest()[:12]
         cache = os.path.join(
             os.path.expanduser("~"), ".cache", "nerrf_tpu", "xla", host)
         os.makedirs(cache, exist_ok=True)

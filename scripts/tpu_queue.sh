@@ -70,9 +70,13 @@ log "1/9 joint-100h training"
 # come up for only minutes and died: re-verify it before EVERY attempt so a
 # flap doesn't burn a 2 h timeout against a dead link — a failed attempt
 # goes back to waiting, not straight into the next attempt.
+# NERRF_REQUIRE_ACCEL: if the tunnel flaps between wait_for_tpu and the
+# run's own in-process probe, fail fast and come back to waiting — never
+# burn a 7200 s timeout grinding flagship shapes on this host's one core
 for attempt in 1 2 3; do
   wait_for_tpu
-  timeout 7200 python -m nerrf_tpu.train.run --experiment joint-100h \
+  NERRF_REQUIRE_ACCEL=1 timeout 7200 python -m nerrf_tpu.train.run \
+    --experiment joint-100h \
     --out runs/joint-100h --ckpt-every 2000 > /tmp/joint100.log 2>&1
   rc=$?
   log "joint-100h attempt $attempt rc=$rc"
@@ -86,7 +90,8 @@ fi
 log "2/9 joint-dense training (deployed 4096n/8192e bucket)"
 for attempt in 1 2; do
   wait_for_tpu
-  timeout 7200 python -m nerrf_tpu.train.run --experiment joint-dense \
+  NERRF_REQUIRE_ACCEL=1 timeout 7200 python -m nerrf_tpu.train.run \
+    --experiment joint-dense \
     --out runs/joint-dense --ckpt-every 1000 > /tmp/jointdense.log 2>&1
   rc=$?
   log "joint-dense attempt $attempt rc=$rc"

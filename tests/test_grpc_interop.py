@@ -235,3 +235,47 @@ def test_reflection_file_by_filename_and_not_found(synthetic_daemon):
 
     missing = _reflect(synthetic_daemon, _ld(4, b"no.such.Symbol"))
     assert 7 in missing, f"expected error_response, got {missing}"
+
+
+def test_replay_mode_delivers_trace_with_parity(tmp_path):
+    """--replay streams a real incident trace through the daemon: every
+    event must arrive through stock grpcio, with syscalls/paths intact and
+    the stream ending in a clean grpc-status 0 (not a RST).  This is the
+    transport leg of the end-to-end wire artifact
+    (benchmarks/run_e2e_daemon.py)."""
+    if not DAEMON.exists():
+        pytest.skip("daemon not built")
+    from nerrf_tpu.data import SimConfig, simulate_trace
+    from nerrf_tpu.ingest.service import TrackerClient
+    from nerrf_tpu.schema.events import events_to_jsonl
+
+    tr = simulate_trace(SimConfig(duration_sec=20.0, attack=True,
+                                  attack_start_sec=5.0, seed=8))
+    n_src = int(tr.events.num_valid)
+    trace_path = tmp_path / "trace.jsonl"
+    trace_path.write_text(events_to_jsonl(tr.events, tr.strings))
+
+    proc = subprocess.Popen(
+        [str(DAEMON), "--listen", "127.0.0.1:0",
+         "--replay", str(trace_path), "--replay-rate", "5000",
+         "--max-seconds", "60"],
+        stderr=subprocess.PIPE, text=True)
+    try:
+        port = None
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            line = proc.stderr.readline()
+            m = re.search(r"\(port (\d+)\)", line)
+            if m:
+                port = int(m.group(1))
+                break
+        assert port, "daemon never reported its port"
+        events, strings = TrackerClient(f"127.0.0.1:{port}").stream(
+            max_events=n_src + 100, timeout=30.0)
+        assert int(events.num_valid) == n_src
+        new_paths = {strings.lookup(int(i))
+                     for i in events.new_path_id[events.valid]}
+        assert any(p.endswith(".lockbit3") for p in new_paths)
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
