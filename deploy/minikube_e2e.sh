@@ -127,6 +127,19 @@ kubectl -n nerrf exec nerrf-victim -- \
   --timeout 60 > "$OUT/ingest.json" || true
 
 # --- 6. detect + gated undo on the WIRE copy -------------------------------
+# The tracker entrypoint falls back to REPLAYING the bundled toy trace when
+# the node refuses BPF (tracker-entrypoint.sh) — that stream has nothing to
+# do with the victim's files, and detecting on it would silently produce a
+# garbage dry-run plan.  Only the live-capture flavor's wire copy is the
+# incident's wire copy.
+if grep -q "capturing" "$OUT/tracker.log"; then
+  UNDO_TRACE=(--trace /app/uploads/wire_trace.jsonl)
+  log "tracker is LIVE-capturing: undo will detect on the wire copy"
+else
+  UNDO_TRACE=()
+  log "tracker is in replay fallback (no BPF on node): wire copy is the"
+  log "toy trace, NOT the incident — undo detects on the local trace"
+fi
 log "export wire store -> detect + dry-run undo"
 kubectl -n nerrf exec nerrf-victim -- python -c '
 import sys; sys.path.insert(0, "/app")
@@ -139,7 +152,7 @@ print("wire events:", int(ev.num_valid))
 ' > "$OUT/wire_export.log" || true
 kubectl -n nerrf exec nerrf-victim -- \
   python -m nerrf_tpu.cli undo --incident /app/uploads/incident \
-  --trace /app/uploads/wire_trace.jsonl \
+  "${UNDO_TRACE[@]}" \
   --dry-run > "$OUT/undo_dryrun.json" || true
 kubectl -n nerrf exec nerrf-victim -- \
   python -m nerrf_tpu.cli status --incident /app/uploads/incident \

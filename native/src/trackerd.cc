@@ -145,6 +145,7 @@ struct CaptureCtx {
   int events_in_batch = 0;
   int batch_size = 64;
   int64_t boot_wall_ns = 0;  // CLOCK_REALTIME - CLOCK_MONOTONIC at startup
+  bool resolve_fd_paths = false;  // live capture only: /proc is the truth
   Broadcaster *bcast = nullptr;
   Stats *stats = nullptr;
 };
@@ -169,6 +170,37 @@ void flush_batch(CaptureCtx *cx) {
 
 void on_event(void *user, const struct nerrf_event_record *rec) {
   CaptureCtx *cx = static_cast<CaptureCtx *>(user);
+
+  // fd→path resolution for fd-based syscalls (write/read): the entry
+  // probe can only stash the fd (in ret_val's slot — capture.cc kSpecs);
+  // the path lives in /proc/<pid>/fd while the fd is open.  Resolving
+  // here, inside the ~100 ms poll round, catches every fd that lives
+  // longer than the ring-buffer latency (a file being encrypted stays
+  // open for its whole chunked rewrite).  Sub-poll-lifetime fds
+  // (open→write→close in one breath) stay pathless — a documented gap
+  // live capture shares with the reference's tracker.
+  // LIVE CAPTURE ONLY (resolve_fd_paths): a replayed trace's pathless
+  // events carry historical pids — readlinking /proc/<pid>/fd on the
+  // replay host would attach some unrelated current process's fd target
+  // as a phantom path in the detector's input.
+  nerrf_event_record resolved;
+  if (cx->resolve_fd_paths &&
+      (rec->syscall_id == NERRF_SC_WRITE ||
+       rec->syscall_id == NERRF_SC_READ) &&
+      rec->path[0] == '\0' && rec->ret_val >= 0) {
+    resolved = *rec;
+    char link[64];
+    snprintf(link, sizeof(link), "/proc/%u/fd/%lld", rec->pid,
+             (long long)rec->ret_val);
+    ssize_t n = readlink(link, resolved.path, sizeof(resolved.path) - 1);
+    if (n > 0)
+      resolved.path[n] = '\0';
+    else
+      resolved.path[0] = '\0';
+    resolved.ret_val = 0;  // the stashed fd is NOT a syscall return value
+    rec = &resolved;
+  }
+
   std::string ev;
   ev.reserve(96);
 
@@ -411,6 +443,7 @@ int main(int argc, char **argv) {
                     (rt.tv_nsec - mt.tv_nsec);
   cx.bcast = &bcast;
   cx.stats = &stats;
+  cx.resolve_fd_paths = (cap != nullptr);
 
   signal(SIGINT, on_signal);
   signal(SIGTERM, on_signal);

@@ -157,3 +157,44 @@ class TrackerClient:
         blocks = [b for b, _ in self.iter_blocks(max_events, timeout)]
         events = EventArrays.concatenate(blocks) if blocks else EventArrays.empty(0)
         return events, self._bridge.string_table()
+
+
+def spawn_trackerd(extra_args, daemon_path=None, timeout=10.0,
+                   build=True):
+    """Start the native daemon on an ephemeral port → ``(Popen, port)``.
+
+    The ONE implementation of the spawn + serving-line parse that the
+    interop tests and the e2e benchmarks previously each hand-rolled
+    (three drifting copies of the same stderr regex).  Always passes
+    ``--listen 127.0.0.1:0`` — a fixed port collides with concurrent
+    runs — and parses the resolved port from the daemon's serving line.
+    Raises RuntimeError if the daemon never reports one; the caller owns
+    termination."""
+    import re as _re
+    import subprocess as _sp
+    import time as _time
+    from pathlib import Path as _Path
+
+    repo = _Path(__file__).resolve().parents[2]
+    daemon = _Path(daemon_path) if daemon_path else (
+        repo / "native" / "build" / "nerrf-trackerd")
+    if not daemon.exists() and build:
+        r = _sp.run(["make", "-C", str(repo / "native"),
+                     "build/nerrf-trackerd"], capture_output=True, text=True)
+        if r.returncode != 0:
+            raise RuntimeError(f"daemon build failed: {r.stderr[-400:]}")
+    proc = _sp.Popen([str(daemon), "--listen", "127.0.0.1:0"]
+                     + list(extra_args),
+                     stderr=_sp.PIPE, text=True)
+    deadline = _time.time() + timeout
+    lines = []
+    while _time.time() < deadline:
+        line = proc.stderr.readline()
+        if not line and proc.poll() is not None:
+            break
+        lines.append(line)
+        m = _re.search(r"serving StreamEvents on .* \(port (\d+)\)", line)
+        if m:
+            return proc, int(m.group(1))
+    proc.terminate()
+    raise RuntimeError(f"daemon never reported its serving port: {lines}")

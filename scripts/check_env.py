@@ -11,9 +11,16 @@ and prints one line per requirement plus a machine-readable JSON summary.
 Exit code 0 iff every REQUIRED row passes.
 
 Check-only by default (native rows verify existing build artifacts); pass
-``--build`` to compile the native libraries first.
+``--build`` to compile the native libraries first, or ``--fix`` to also
+REMEDIATE what can be remediated — the install half of the reference's
+`install-deps.sh:94-313` scope: build the native libraries, mount the BPF
+filesystem, and (when apt-get exists on the host) install missing
+toolchain packages.  Kernel config rows (CONFIG_BPF*) are verified like
+`install-deps.sh:94-140` but can only be reported, not fixed.  Every fix
+is logged and the checks re-run afterwards, so the output is always the
+POST-fix state.
 
-Usage: python scripts/check_env.py [--json] [--build]
+Usage: python scripts/check_env.py [--json] [--build] [--fix]
 """
 
 from __future__ import annotations
@@ -123,7 +130,87 @@ def _kvm():
     return "microVM sandbox available"
 
 
-def main() -> int:
+def _bpffs():
+    def fn():
+        if not os.path.isdir("/sys/fs/bpf"):
+            raise FileNotFoundError("/sys/fs/bpf missing")
+        with open("/proc/mounts") as f:
+            if not any(line.split()[1] == "/sys/fs/bpf" for line in f):
+                raise RuntimeError("bpffs not mounted at /sys/fs/bpf")
+        return "mounted"
+    return fn
+
+
+def _kernel_config():
+    """CONFIG_BPF/BPF_SYSCALL/BPF_EVENTS, from /proc/config.gz or
+    /boot/config-$(uname -r) — install-deps.sh:102-123's check."""
+    def fn():
+        import gzip
+        import platform
+
+        text = None
+        if os.path.exists("/proc/config.gz"):
+            text = gzip.open("/proc/config.gz", "rt").read()
+        else:
+            boot = f"/boot/config-{platform.release()}"
+            if os.path.exists(boot):
+                text = open(boot).read()
+        if text is None:
+            return "no kernel config exposed (skipping)"
+        missing = [c for c in ("CONFIG_BPF=y", "CONFIG_BPF_SYSCALL=y",
+                               "CONFIG_BPF_EVENTS=y")
+                   if f"\n{c}" not in text and not text.startswith(c)]
+        if missing:
+            raise RuntimeError(f"disabled: {', '.join(missing)}")
+        return "CONFIG_BPF, CONFIG_BPF_SYSCALL, CONFIG_BPF_EVENTS"
+    return fn
+
+
+# tool → Debian package, for the --fix apt path (install-deps.sh:128-141)
+_APT_PACKAGES = {"g++": "build-essential", "make": "build-essential",
+                 "clang": "clang", "protoc": "protobuf-compiler",
+                 "cmake": "cmake", "ninja": "ninja-build"}
+
+
+def apply_fixes(rows) -> list:
+    """Remediate what a failed row allows; returns log lines.  Anything
+    needing capabilities the host refuses (mount in an unprivileged
+    container, no apt-get) degrades to a logged skip, never a crash."""
+    fixes = []
+    failed = {r["name"] for r in rows if not r["ok"]}
+
+    # toolchain FIRST: the native build below needs the compiler a fresh
+    # host may be missing — the other order can't converge in one run
+    missing_tools = [t for t in _APT_PACKAGES
+                     if f"toolchain:{t}" in failed]
+    if missing_tools:
+        if shutil.which("apt-get"):
+            pkgs = sorted({_APT_PACKAGES[t] for t in missing_tools})
+            r = subprocess.run(["apt-get", "install", "-y"] + pkgs,
+                               capture_output=True, text=True)
+            fixes.append(f"apt-get install {' '.join(pkgs)}: "
+                         f"rc={r.returncode}")
+        else:
+            fixes.append(f"toolchain missing ({', '.join(missing_tools)}) "
+                         "but no apt-get on this host — install manually")
+
+    if "native:libraries" in failed:
+        r = subprocess.run(["make", "-C", os.path.join(REPO, "native")],
+                           capture_output=True, text=True)
+        fixes.append(f"built native libraries: rc={r.returncode}"
+                     + ("" if r.returncode == 0 else
+                        f" ({r.stderr.strip().splitlines()[-1][:120]})"))
+
+    if "kernel:bpffs" in failed and os.path.isdir("/sys/fs/bpf"):
+        r = subprocess.run(["mount", "-t", "bpf", "bpf", "/sys/fs/bpf"],
+                           capture_output=True, text=True)
+        fixes.append(f"mount bpffs: rc={r.returncode}"
+                     + ("" if r.returncode == 0 else
+                        f" ({r.stderr.strip()[:120]})"))
+    return fixes
+
+
+def run_checks() -> list:
     rows = []
     for mod in REQUIRED_MODULES:
         rows.append(check(f"python:{mod}", _module(mod)))
@@ -152,11 +239,26 @@ def main() -> int:
                 r.returncode, f"probe rc={r.returncode}"))
 
     rows.append(check("capture:live-bpf", _capture_probe, required=False))
+    rows.append(check("kernel:bpffs", _bpffs(), required=False))
+    rows.append(check("kernel:config", _kernel_config(), required=False))
+    return rows
+
+
+def main() -> int:
+    rows = run_checks()
+    fixes = []
+    if "--fix" in sys.argv:
+        fixes = apply_fixes(rows)
+        if fixes:
+            rows = run_checks()  # report the POST-fix state
 
     ok = all(r["ok"] for r in rows if r["required"])
     if "--json" in sys.argv:
-        print(json.dumps({"ok": ok, "checks": rows}, indent=2))
+        print(json.dumps({"ok": ok, "fixes": fixes or None,
+                          "checks": rows}, indent=2))
     else:
+        for f in fixes:
+            print(f"[fix ] {f}")
         for r in rows:
             mark = "ok " if r["ok"] else ("FAIL" if r["required"] else "skip")
             print(f"[{mark}] {r['name']:28s} {r['detail']}")

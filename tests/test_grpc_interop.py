@@ -29,31 +29,13 @@ _METHOD = "/nerrf.trace.Tracker/StreamEvents"
 
 @pytest.fixture(scope="module")
 def synthetic_daemon():
-    if not DAEMON.exists():
-        r = subprocess.run(
-            ["make", "-C", str(REPO / "native"), "build/nerrf-trackerd"],
-            capture_output=True, text=True)
-        if r.returncode != 0:
-            pytest.skip(f"daemon build failed: {r.stderr[-400:]}")
-    # ephemeral port (`:0`): a fixed port collides with concurrent pytest
-    # runs or a leaked daemon from an interrupted session; the daemon logs
-    # the resolved port on its serving line
-    proc = subprocess.Popen(
-        [str(DAEMON), "--listen", "127.0.0.1:0",
-         "--synthetic", "2000", "--max-seconds", "120"],
-        stderr=subprocess.PIPE, text=True)
-    port = None
-    deadline = time.time() + 10
-    while time.time() < deadline:
-        line = proc.stderr.readline()
-        if not line:
-            break
-        m = re.search(r"serving StreamEvents on .* \(port (\d+)\)", line)
-        if m:
-            port = int(m.group(1))
-            break
-    assert port, "daemon never reported its serving port"
-    assert proc.poll() is None
+    from nerrf_tpu.ingest.service import spawn_trackerd
+
+    try:
+        proc, port = spawn_trackerd(["--synthetic", "2000",
+                                     "--max-seconds", "120"])
+    except RuntimeError as e:
+        pytest.skip(str(e))
     yield port
     proc.terminate()
     proc.wait(timeout=10)
@@ -243,10 +225,8 @@ def test_replay_mode_delivers_trace_with_parity(tmp_path):
     the stream ending in a clean grpc-status 0 (not a RST).  This is the
     transport leg of the end-to-end wire artifact
     (benchmarks/run_e2e_daemon.py)."""
-    if not DAEMON.exists():
-        pytest.skip("daemon not built")
     from nerrf_tpu.data import SimConfig, simulate_trace
-    from nerrf_tpu.ingest.service import TrackerClient
+    from nerrf_tpu.ingest.service import TrackerClient, spawn_trackerd
     from nerrf_tpu.schema.events import events_to_jsonl
 
     tr = simulate_trace(SimConfig(duration_sec=20.0, attack=True,
@@ -255,21 +235,10 @@ def test_replay_mode_delivers_trace_with_parity(tmp_path):
     trace_path = tmp_path / "trace.jsonl"
     trace_path.write_text(events_to_jsonl(tr.events, tr.strings))
 
-    proc = subprocess.Popen(
-        [str(DAEMON), "--listen", "127.0.0.1:0",
-         "--replay", str(trace_path), "--replay-rate", "5000",
-         "--max-seconds", "60"],
-        stderr=subprocess.PIPE, text=True)
+    proc, port = spawn_trackerd(["--replay", str(trace_path),
+                                 "--replay-rate", "5000",
+                                 "--max-seconds", "60"])
     try:
-        port = None
-        deadline = time.time() + 10
-        while time.time() < deadline:
-            line = proc.stderr.readline()
-            m = re.search(r"\(port (\d+)\)", line)
-            if m:
-                port = int(m.group(1))
-                break
-        assert port, "daemon never reported its port"
         events, strings = TrackerClient(f"127.0.0.1:{port}").stream(
             max_events=n_src + 100, timeout=30.0)
         assert int(events.num_valid) == n_src
