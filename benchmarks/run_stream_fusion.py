@@ -1,0 +1,222 @@
+#!/usr/bin/env python3
+"""StreamNet fusion uplift measurement (VERDICT r4 weak #6 / next #5).
+
+StreamNet's entire reason to exist is catching what the 45 s window models
+miss: slow-burn incidents whose evidence accumulates ACROSS windows
+(recon → dwell → encrypt), where any single window looks benign
+(`nerrf_tpu/models/stream.py:1-18`).  Nothing before r5 ever measured
+that.  This harness does, at file and incident granularity, on the
+scenarios engineered to be slow ("slow-drip" spreads the attack over 80%
+of the trace; "exfil-encrypt" stages read-exfil → dwell → partial
+encrypt), with "standard" as the control:
+
+  window  — the joint model's file flags at its calibrated cut
+  stream  — StreamNet event flags at ITS calibrated cut (logit space —
+            the sidecar records the space), attributed to files through
+            the event's path and gated on mutation exactly like the
+            window detector (an un-mutated file cannot be undone)
+  fusion  — union of the two flag sets
+
+The deliverable is the measured per-scenario detection delta (fusion −
+window) at matched FP-undo discipline — INCLUDING "no uplift" if that is
+what the numbers say (the VERDICT's ask: demonstrate uplift or say so).
+
+Usage:
+  python benchmarks/run_stream_fusion.py --out benchmarks/results/stream_fusion.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+sys.path.insert(0, str(Path(__file__).resolve().parent))
+
+import numpy as np
+
+SCENARIOS = ("slow-drip", "exfil-encrypt", "interleaved-backup", "standard")
+
+
+def _log(m):
+    print(f"[fusion] {m}", file=sys.stderr, flush=True)
+
+
+def stream_file_flags(trace, params, model, threshold: float,
+                      max_len: int, batch: int = 8) -> set:
+    """StreamNet event flags → undoable file set.
+
+    Reproduces build_stream's event selection exactly (valid, non-MARKER,
+    stream order) so segment positions map back to event rows, then
+    attributes each flagged event to its path and keeps only files the
+    trace actually mutates — same undo-candidacy rule as the window
+    pipeline (pipeline.py: restoring an unmutated file is an FP undo by
+    definition)."""
+    import jax
+
+    from nerrf_tpu.data.stream import build_stream
+    from nerrf_tpu.pipeline import MUTATING_SYSCALLS, _inode_to_path
+    from nerrf_tpu.schema.events import Syscall
+
+    # inode-canonical names: attack events carry PRE-rename paths while the
+    # ground truth (and the window detector) key on the file's final name —
+    # string-keyed attribution scores 0 on every renamed victim
+    ino_path = _inode_to_path(trace)
+
+    def canon(row) -> str:
+        if trace.events.inode[row] != 0:
+            return ino_path.get(int(trace.events.inode[row]), "")
+        return trace.strings.lookup(int(trace.events.path_id[row]))
+
+    ev = trace.events
+    sel = ev.valid & (ev.syscall != int(Syscall.MARKER))
+    idx = np.nonzero(sel)[0]
+    sb = build_stream(trace, max_len=max_len)
+    if len(sb) == 0:
+        return set()
+
+    @jax.jit
+    def fwd(p, feat, mask):
+        return model.apply({"params": p}, feat, mask, deterministic=True)
+
+    flagged_events = []
+    n = len(sb)
+    for i in range(0, n, batch):
+        take = np.arange(i, min(i + batch, n))
+        full = np.resize(take, batch)  # fixed batch shape → one compile
+        out = jax.device_get(fwd(params, sb.feat[full], sb.mask[full]))
+        logits = out["event_logits"]
+        for j, seg in enumerate(take):
+            m = sb.mask[seg]
+            hot = np.nonzero((logits[j] > threshold) & m)[0]
+            flagged_events.extend(int(seg) * sb.feat.shape[1] + hot)
+
+    mutated = set()
+    for i in idx:
+        if int(ev.syscall[i]) in MUTATING_SYSCALLS:
+            if ev.inode[i] != 0:
+                mutated.add(ino_path.get(int(ev.inode[i]), ""))
+            for f in (ev.path_id[i], ev.new_path_id[i]):
+                p = trace.strings.lookup(int(f))
+                if p:
+                    mutated.add(p)
+    flags = set()
+    for pos in flagged_events:
+        if pos < len(idx):
+            p = canon(idx[pos])
+            if p and p in mutated:
+                flags.add(p)
+    return flags
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--out", default="benchmarks/results/stream_fusion.json")
+    ap.add_argument("--traces", type=int, default=6)
+    ap.add_argument("--seed", type=int, default=505)
+    ap.add_argument("--max-len", type=int, default=1024,
+                    help="stream segment length (must match the stream "
+                         "checkpoint's training length)")
+    ap.add_argument("--model-dir", default="runs/probe-corpus-cpu/model")
+    ap.add_argument("--stream-dir", default="runs/stream-probe")
+    ap.add_argument("--platform", default=None)
+    args = ap.parse_args(argv)
+
+    from nerrf_tpu.utils import enable_compilation_cache
+
+    enable_compilation_cache()
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+
+    from run_adversarial_eval import _attacked_files, _scenario_traces
+
+    from nerrf_tpu.models import NerrfNet, StreamNet
+    from nerrf_tpu.pipeline import model_detect
+    from nerrf_tpu.train.checkpoint import (
+        load_calibration,
+        load_checkpoint,
+        load_stream_checkpoint,
+    )
+
+    t0 = time.time()
+    params, mcfg = load_checkpoint(args.model_dir)
+    wcal = load_calibration(args.model_dir)
+    wmodel = NerrfNet(mcfg)
+    sparams, scfg, scal = load_stream_checkpoint(args.stream_dir)
+    smodel = StreamNet(scfg)
+    s_thr = scal.get("stream_event_threshold")
+    assert s_thr is not None, "stream checkpoint has no calibrated cut"
+    assert scal.get("stream_event_threshold_space", "logit") == "logit"
+    _log(f"window cut {wcal.get('node_threshold')} / "
+         f"stream cut {s_thr} (logit)")
+
+    report = {"backend": jax.default_backend(),
+              "window_model": args.model_dir,
+              "stream_model": args.stream_dir,
+              "scenarios": {}}
+    for scenario in SCENARIOS:
+        _log(f"scenario {scenario}…")
+        traces = _scenario_traces(scenario, args.traces, args.seed)
+        counts = {"window": [0, 0, 0], "stream": [0, 0, 0],
+                  "fusion": [0, 0, 0]}  # tp, flagged, attacked
+        inc = {"window": 0, "stream": 0, "fusion": 0}
+        fp = {"window": 0, "stream": 0, "fusion": 0}
+        for tr in traces:
+            wdet = model_detect(tr, params, wmodel,
+                                threshold=wcal.get("node_threshold"))
+            wflags = set(wdet.flagged_files())
+            sflags = stream_file_flags(tr, sparams, smodel, s_thr,
+                                       args.max_len)
+            encrypted, touched = _attacked_files(tr)
+            for name, flags in (("window", wflags), ("stream", sflags),
+                                ("fusion", wflags | sflags)):
+                counts[name][0] += len(flags & encrypted)
+                counts[name][1] += len(flags)
+                counts[name][2] += len(encrypted)
+                fp[name] += len(flags - touched)
+                if flags & encrypted:
+                    inc[name] += 1
+        entry = {}
+        for name in ("window", "stream", "fusion"):
+            tp, fl, atk = counts[name]
+            entry[name] = {
+                "detection_rate": round(tp / atk, 4) if atk else None,
+                "fp_undo_rate": round(fp[name] / fl, 4) if fl else 0.0,
+                "incidents_detected": inc[name],
+                "incidents": len(traces),
+            }
+        entry["fusion_detection_delta"] = (
+            round((entry["fusion"]["detection_rate"] or 0.0)
+                  - (entry["window"]["detection_rate"] or 0.0), 4)
+            if entry["window"]["detection_rate"] is not None else None)
+        report["scenarios"][scenario] = entry
+        _log(f"  {scenario}: {json.dumps(entry)}")
+
+    helps = sorted(
+        sc for sc, e in report["scenarios"].items()
+        if (e["fusion_detection_delta"] or 0) > 0
+        and e["fusion"]["fp_undo_rate"] < 0.05)
+    report["summary"] = {
+        "fusion_helps_scenarios": helps,
+        "verdict": (f"fusion adds detection on {helps} at <5% FP-undo"
+                    if helps else
+                    "no measured uplift: the window models alone match "
+                    "fusion on every scenario tested — StreamNet remains "
+                    "an extra capability without incident-level evidence"),
+    }
+    report["provenance"] = "python benchmarks/run_stream_fusion.py"
+    report["wall_seconds"] = round(time.time() - t0, 1)
+    out = Path(args.out)
+    out.parent.mkdir(parents=True, exist_ok=True)
+    out.write_text(json.dumps(report, indent=2) + "\n")
+    print(json.dumps(report["summary"]))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
