@@ -1,0 +1,69 @@
+"""Detector warm-boot sweep (pipeline.warmup_detector + `nerrf warmup`)."""
+
+import json
+import subprocess
+import sys
+
+
+
+def test_warmup_detector_compiles_each_bucket():
+    """The sweep compiles the detector eval program per bucket and returns
+    timings keyed by bucket tag.  (Cross-process reuse rides the
+    persistent compilation cache, which tests leave disabled — covered by
+    benchmarks/run_warmboot_bench.py, not here.)"""
+    import jax
+
+    from nerrf_tpu.models import JointConfig, NerrfNet
+    from nerrf_tpu.pipeline import warmup_detector
+    from nerrf_tpu.train.loop import TrainConfig, init_state
+    from nerrf_tpu.train import build_dataset
+    from nerrf_tpu.data import make_corpus
+
+    cfg = JointConfig().small
+    model = NerrfNet(cfg)
+    corpus = make_corpus(2, duration_sec=30.0, num_target_files=4,
+                         benign_rate_hz=4.0)
+    ds = build_dataset(corpus)
+    params = init_state(model, TrainConfig(model=cfg, num_steps=1),
+                        ds.arrays, jax.random.PRNGKey(0)).params
+
+    buckets = ((128, 256, 32), (256, 512, 64))
+    times = warmup_detector(params, model, buckets=buckets, batch_size=2)
+    assert set(times) == {"128n/256e/32s", "256n/512e/64s"}
+    assert all(t >= 0 for t in times.values())
+
+
+def test_warmup_bucket_ladder_covers_cross_product():
+    """auto-capacity buckets dims independently — the default sweep must be
+    the cross product, not the diagonal (r5 review finding)."""
+    from nerrf_tpu.pipeline import (
+        DETECTOR_WARMUP_BUCKETS,
+        _GRAPH_WARMUP_RUNGS,
+        _SEQ_WARMUP_RUNGS,
+    )
+
+    assert len(DETECTOR_WARMUP_BUCKETS) == (
+        len(_GRAPH_WARMUP_RUNGS) * len(_SEQ_WARMUP_RUNGS))
+    assert (4096, 8192, 128) in DETECTOR_WARMUP_BUCKETS  # off-diagonal
+    assert (1024, 2048, 512) in DETECTOR_WARMUP_BUCKETS
+
+
+def test_check_env_doctor_runs_and_reports(repo_root):
+    """The doctor's JSON contract: every row has name/ok/required/detail,
+    and the new kernel rows exist.  (--fix is NOT exercised here: it
+    mutates the host.)"""
+    r = subprocess.run(
+        [sys.executable, str(repo_root / "scripts" / "check_env.py"),
+         "--json", "--skip-backend"],
+        capture_output=True, text=True, timeout=400)
+    out = json.loads(r.stdout)
+    names = {c["name"] for c in out["checks"]}
+    assert {"python:jax", "toolchain:g++", "native:libraries",
+            "kernel:bpffs", "kernel:config"} <= names
+    for c in out["checks"]:
+        assert set(c) == {"name", "ok", "required", "detail"}
+    # jax:backend probes the accelerator and may legitimately fail here;
+    # required python/toolchain rows must hold on this image
+    assert all(c["ok"] for c in out["checks"]
+               if c["required"] and c["name"].startswith(("python:",
+                                                          "toolchain:")))
