@@ -27,6 +27,8 @@ sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
 
 import numpy as np
 
+from nerrf_tpu.utils import sync_result
+
 
 def _log(m):
     print(f"[cap] {m}", file=sys.stderr, flush=True)
@@ -126,7 +128,7 @@ def bench_segment_crossover(report: dict) -> None:
 
         def timed(fn):
             out = fn(ids_d, data_d)
-            jax.block_until_ready(out)
+            sync_result(out)
             t0 = time.perf_counter()
             reps = 50
             for _ in range(reps):

@@ -102,7 +102,7 @@ def main(argv=None) -> int:
                          "sidecar here ('' skips)")
     args = ap.parse_args(argv)
 
-    from nerrf_tpu.utils import enable_compilation_cache
+    from nerrf_tpu.utils import enable_compilation_cache, sync_result
 
     enable_compilation_cache()
     import jax
@@ -149,7 +149,7 @@ def main(argv=None) -> int:
                                replace=len(train_sb) < args.batch)
             batch = place({k: v[idx] for k, v in arrays.items()})
             state, loss, rng = step_fn(state, batch, rng)
-        jax.block_until_ready(loss)
+        sync_result(loss)
         train_secs = time.perf_counter() - t_train
         _log(f"trained {args.steps} steps in {train_secs:.1f}s "
              f"(final loss {float(loss):.4f})")

@@ -443,7 +443,12 @@ def _sorted_kernels_compile(interpret: bool) -> bool:
             )(d, ids)
             return jnp.sum(out * out)
 
-        jax.block_until_ready(jax.jit(jax.grad(loss))(data))
+        # fetch, not block_until_ready (a no-op on the axon platform):
+        # an execute-time kernel failure must raise inside this try or the
+        # probe would falsely register the banded kernels as available
+        from nerrf_tpu.utils import sync_result
+
+        sync_result(jax.jit(jax.grad(loss))(data))
         return True
     except Exception as e:
         import sys

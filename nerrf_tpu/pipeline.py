@@ -17,6 +17,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from nerrf_tpu.utils import sync_result
+
 from nerrf_tpu.data.loaders import Trace
 from nerrf_tpu.graph.builder import NODE_TYPE_FILE, NODE_TYPE_PROCESS
 from nerrf_tpu.models import NerrfNet
@@ -219,7 +221,7 @@ def warmup_detector(params, model: NerrfNet,
             for k, v in s0.items()}
         tag = f"{max_nodes}n/{max_edges}e/{max_seqs}s"
         t0 = _time.perf_counter()
-        jax.block_until_ready(eval_fn(params, batch))
+        sync_result(eval_fn(params, batch))
         times[tag] = round(_time.perf_counter() - t0, 1)
         if log:
             log(f"detector bucket {tag} warm ({times[tag]}s)")

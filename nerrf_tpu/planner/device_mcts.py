@@ -53,6 +53,7 @@ from nerrf_tpu.planner.domain import (
     UndoPlan,
 )
 from nerrf_tpu.planner.mcts import MCTSConfig, extract_plan
+from nerrf_tpu.utils import sync_result
 from nerrf_tpu.planner.value_net import heuristic_value
 
 
@@ -410,8 +411,11 @@ class DeviceMCTS:
         t0 = time.perf_counter()
         tree = self._init_tree(
             jnp.asarray(self._pad_state(self.domain.initial_state())))
-        jax.block_until_ready(
-            self._search_chunk(tree, jnp.asarray(1, jnp.int32), self._ctx))
+        out = self._search_chunk(tree, jnp.asarray(1, jnp.int32), self._ctx)
+        # fetch, not block_until_ready (a no-op on the axon platform): the
+        # warmup is make_planner's compile-AND-execute gate — an execute-time
+        # failure must raise HERE so 'auto' can fall back to the host search
+        sync_result(out)
         return time.perf_counter() - t0
 
     @classmethod

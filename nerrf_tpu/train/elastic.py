@@ -37,6 +37,8 @@ from typing import Dict, Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from nerrf_tpu.utils import sync_result
 import orbax.checkpoint as ocp
 
 from nerrf_tpu.models.joint import NerrfNet
@@ -183,7 +185,7 @@ def train_elastic(
             batch = {k: jnp.asarray(v[idx]) for k, v in train_ds.arrays.items()}
             state, loss, aux, _ = train_step(state, batch, step_rng)
         if t_start is None:
-            jax.block_until_ready(loss)
+            sync_result(loss)
             t_start = time.perf_counter()
         if fault is not None:
             fault(step)
@@ -198,7 +200,7 @@ def train_elastic(
             if log:
                 log(f"step {step}: loss={float(loss):.4f} (checkpointed)")
 
-    jax.block_until_ready(state.params)
+    sync_result(state.params)
     elapsed = time.perf_counter() - (t_start or time.perf_counter())
     steps = cfg.num_steps - start
     steps_per_sec = max(steps - 1, 1) / elapsed if elapsed > 0 else 0.0

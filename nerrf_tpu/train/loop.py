@@ -23,6 +23,8 @@ from typing import Any, Dict, Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from nerrf_tpu.utils import sync_result
 import optax
 from flax.training import train_state
 
@@ -124,7 +126,7 @@ def make_train_step_resident(model: NerrfNet, cfg: TrainConfig, arrays):
     gathers its batch on device, so per-step host→device traffic is just the
     [batch] index vector — on TPU this removes the transfer of ~MBs of
     padded windows from the critical path."""
-    step, _ = _make_resident_steps(model, cfg, arrays)
+    step, _, _ = _make_resident_steps(model, cfg, arrays)
     return step
 
 
@@ -161,7 +163,11 @@ def device_put_chunked(arrays, max_bytes: int = 64 << 20, block: bool = False,
                       for i in range(0, v.shape[0], rows)]
             out[k] = jnp.concatenate(pieces, axis=0)
     if block:
-        jax.block_until_ready(out)
+        # per-array barrier: the uploads are independent transfers, so
+        # syncing one leaf would not prove the others landed — fetch a
+        # scalar carved from each (one cheap round trip per array)
+        for v in out.values():
+            np.asarray(jax.device_get(v[(0,) * v.ndim]))
         if log:
             dt = time.perf_counter() - t0
             log(f"upload: {total / 1e9:.2f} GB in {dt:.1f}s "
@@ -177,8 +183,18 @@ def make_train_step_scheduled(model: NerrfNet, cfg: TrainConfig, arrays,
     back steps pipeline instead of syncing on per-step input uploads (the
     dominant cost over a remote-dispatch link).  ``idx_table`` is
     [num_steps, batch] int32."""
-    _, make_scheduled = _make_resident_steps(model, cfg, arrays)
+    _, make_scheduled, _ = _make_resident_steps(model, cfg, arrays)
     return make_scheduled(idx_table)
+
+
+def make_train_superstep(model: NerrfNet, cfg: TrainConfig, arrays,
+                         idx_table: np.ndarray, steps_per_call: int):
+    """K scheduled steps per XLA program — see ``make_super`` in
+    ``_make_resident_steps``.  The benchmark of record times this flavor:
+    per-call host dispatch over the axon tunnel costs a ~67 ms round trip,
+    so the per-step host loop measures the link, not the chip."""
+    _, _, make_super = _make_resident_steps(model, cfg, arrays)
+    return make_super(idx_table, steps_per_call)
 
 
 def _make_resident_steps(model: NerrfNet, cfg: TrainConfig, arrays):
@@ -211,7 +227,33 @@ def _make_resident_steps(model: NerrfNet, cfg: TrainConfig, arrays):
         fn.lower = lambda state, rng: step_by_schedule.lower(state, rng, dev, table)
         return fn
 
-    return resident, make_scheduled
+    def make_super(idx_table, steps_per_call):
+        """K schedule-driven steps per XLA program (``lax.scan`` over the
+        step body).  Over a remote-dispatch link one host call costs a full
+        round trip (~67 ms measured on the axon tunnel), so per-step host
+        loops measure the link, not the chip; scanning K steps inside one
+        program is the TPU-shaped fix — returns (state, losses[K], rng)."""
+        table = jax.device_put(np.asarray(idx_table, np.int32))
+
+        @partial(jax.jit, donate_argnums=(0,), static_argnames=("k",))
+        def superstep(state, rng, data, sched, k):
+            def body(carry, _):
+                st, r = carry
+                idx = jnp.take(sched, st.step % sched.shape[0], axis=0)
+                st, loss, _aux, r = gathered_step(st, idx, r, data)
+                return (st, r), loss
+
+            (state, rng), losses = jax.lax.scan(
+                body, (state, rng), None, length=k)
+            return state, losses, rng
+
+        fn = lambda state, rng: superstep(state, rng, dev, table,
+                                          k=steps_per_call)
+        fn.lower = lambda state, rng: superstep.lower(state, rng, dev, table,
+                                                      k=steps_per_call)
+        return fn
+
+    return resident, make_scheduled, make_super
 
 
 def make_idx_schedule(n: int, cfg: TrainConfig) -> np.ndarray:
@@ -398,7 +440,7 @@ def train_nerrfnet(
             batch = {k: jnp.asarray(v[idx]) for k, v in train_ds.arrays.items()}
             state, loss, aux, rng = train_step(state, batch, rng)
         if step == 0:
-            jax.block_until_ready(loss)
+            sync_result(loss)
             t_start = time.perf_counter()
         if step % cfg.eval_every == 0 or step == cfg.num_steps - 1:
             history.append({"step": step, "loss": float(loss)})
@@ -411,7 +453,7 @@ def train_nerrfnet(
             if log:
                 log(f"step {step}: loss={float(loss):.4f} "
                     + " ".join(f"{k}={float(v):.4f}" for k, v in aux.items()))
-    jax.block_until_ready(state.params)
+    sync_result(state.params)
     elapsed = time.perf_counter() - (t_start or time.perf_counter())
     steps_per_sec = (cfg.num_steps - 1) / elapsed if elapsed > 0 else 0.0
 
@@ -557,7 +599,7 @@ def train_sharded_stream(
                                  replace=False))
                 state, loss, aux, rng = step_by_idx(state, idx, rng, shard)
                 if t_start is None:
-                    jax.block_until_ready(loss)
+                    sync_result(loss)
                     t_start = time.perf_counter()
                     timed_from = steps_done
                 if cfg.eval_every and steps_done % cfg.eval_every == 0:
@@ -581,7 +623,7 @@ def train_sharded_stream(
             pass
         thread.join(timeout=10)
 
-    jax.block_until_ready(state.params)
+    sync_result(state.params)
     if ckpt_dir is not None and save_every > 0:
         _save_full(Path(ckpt_dir), steps_done, state)
     elapsed = time.perf_counter() - (t_start or time.perf_counter())

@@ -23,6 +23,8 @@ import sys
 import time
 from pathlib import Path
 
+from nerrf_tpu.utils import sync_result
+
 
 def _log(msg: str) -> None:
     print(f"[run] {msg}", file=sys.stderr, flush=True)
@@ -129,9 +131,9 @@ def run_experiment(name_or_path: str, out_dir: str | Path,
             batch = shard_batch(mesh, {k: v[idx] for k, v in train_ds.arrays.items()})
             state, loss, aux, rng = step(state, batch, rng)
             if i == 0:
-                jax.block_until_ready(loss)
+                sync_result(loss)
                 t_start = time.perf_counter()
-        jax.block_until_ready(state.params)
+        sync_result(state.params)
         steps_per_sec = (cfg.num_steps - 1) / max(
             time.perf_counter() - (t_start or 0), 1e-9)
         if jax.process_count() > 1:

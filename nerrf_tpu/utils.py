@@ -14,6 +14,38 @@ import sys
 import tempfile
 
 
+def sync_result(x):
+    """Wait for a jitted call's output to actually exist, and return it.
+
+    ``jax.block_until_ready`` is a NO-OP on the axon remote platform (r5:
+    block-based timing reported a matmul chain at 190x the chip's peak), so
+    every timed region and every completion barrier in this codebase syncs
+    by *fetching* instead: a device-to-host copy cannot finish before the
+    program that produces the value.  One XLA program's outputs materialize
+    together, so fetching the smallest output leaf is enough to prove the
+    whole call ran.
+    """
+    import jax
+    import numpy as np
+
+    leaves = [l for l in jax.tree_util.tree_leaves(x)
+              if hasattr(l, "dtype") and hasattr(l, "size")]
+    if leaves:
+        np.asarray(jax.device_get(min(leaves, key=lambda l: l.size)))
+    return x
+
+
+def fetch_value(x):
+    """Device-to-host copy of ``x`` as numpy — the value-returning flavor of
+    ``sync_result`` (same rationale: fetching is the only real barrier on
+    the axon platform).  Use for scalars/small arrays whose value the caller
+    needs anyway; use ``sync_result`` when only completion matters."""
+    import jax
+    import numpy as np
+
+    return np.asarray(jax.device_get(x))
+
+
 # shared tail of every probe child program: the PROBE_OK marker format the
 # parent parses — one definition so the full and enumeration-only programs
 # cannot drift apart
@@ -73,7 +105,10 @@ def probe_backend(timeout_sec: float = 120.0,
         "import jax.numpy as jnp\n"
         "d = jax.devices()\n"
         "y = jax.jit(lambda a: a @ a)(jnp.ones((8, 8), jnp.float32))\n"
-        "y.block_until_ready()\n"
+        # fetch, don't block_until_ready: the latter is a no-op on the
+        # axon remote platform, which would let a dispatch-only relay pass
+        "import numpy as _np\n"
+        "assert float(_np.asarray(y)[0, 0]) == 8.0\n"
         + _PROBE_PRINT_TAIL)
     try:
         with tempfile.TemporaryFile(mode="w+") as out, \

@@ -220,3 +220,52 @@ def test_evaluate_resident_matches_host_slicing(small_dataset):
     assert host.keys() == res.keys()
     for k in host:
         np.testing.assert_allclose(host[k], res[k], rtol=1e-5, atol=1e-6)
+
+
+def test_superstep_matches_scheduled_steps(small_dataset):
+    """K supersteps must be the same training trajectory as K scheduled
+    steps — the benchmark of record times the superstep flavor, so a
+    divergence (schedule indexing, rng threading) would silently change
+    what BENCH measures."""
+    import jax
+
+    from nerrf_tpu.models import NerrfNet
+    from nerrf_tpu.train.loop import (
+        init_state,
+        make_idx_schedule,
+        make_train_step_scheduled,
+        make_train_superstep,
+    )
+
+    ds = small_dataset
+    cfg = TrainConfig(
+        model=JointConfig(
+            gnn=GraphSAGEConfig(hidden=16, num_layers=2, dropout=0.0),
+            lstm=LSTMConfig(hidden=16, num_layers=1, dropout=0.0),
+        ),
+        batch_size=4, num_steps=6, warmup_steps=2, seed=3,
+    )
+    model = NerrfNet(cfg.model)
+    rng = jax.random.PRNGKey(7)
+    idx = make_idx_schedule(len(ds), cfg)
+
+    s1 = init_state(model, cfg, ds.arrays, rng)
+    sched = make_train_step_scheduled(model, cfg, ds.arrays, idx)
+    r = rng
+    for _ in range(cfg.num_steps):
+        s1, loss1, _aux, r = sched(s1, r)
+
+    s2 = init_state(model, cfg, ds.arrays, rng)
+    sup = make_train_superstep(model, cfg, ds.arrays, idx, cfg.num_steps)
+    s2, losses, _r2 = sup(s2, rng)
+
+    assert int(s1.step) == int(s2.step) == cfg.num_steps
+    assert losses.shape == (cfg.num_steps,)
+    np.testing.assert_allclose(float(losses[-1]), float(loss1),
+                               rtol=2e-4, atol=2e-5)
+    l1 = jax.tree_util.tree_leaves(s1.params)
+    l2 = jax.tree_util.tree_leaves(s2.params)
+    for a, b in zip(l1, l2):
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(b, np.float32),
+                                   rtol=2e-3, atol=2e-4)
