@@ -89,8 +89,14 @@ def main() -> int:
                 flag = [False]
                 xs_p = jax.tree_util.tree_map(lambda l: bump(l, flag), xs)
                 out = fn(*xs_p)
-                leaf = jax.tree_util.tree_leaves(out)[0]
-                return c + jnp.sum(leaf).astype(jnp.float32) * 1e-9, ()
+                # consume EVERY output leaf: grad legs return a params-sized
+                # pytree, and feeding only one leaf into the carry lets XLA
+                # dead-code-eliminate the other parameters' backward matmuls
+                # (r5 review catch — it underreported bwd by ~10x once)
+                tot = sum(jnp.sum(l).astype(jnp.float32)
+                          for l in jax.tree_util.tree_leaves(out)
+                          if hasattr(l, "dtype"))
+                return c + tot * 1e-9, ()
 
             c, _ = jax.lax.scan(body, jnp.float32(0.0), None, length=k)
             return c

@@ -1,13 +1,15 @@
-"""Model-FLOPs-utilization accounting for the benchmark of record.
+"""Chip peaks + the XLA cost-analysis cross-check for the bench.
 
 The reference has no chip-side perf baseline (its AI subsystem was never
 built, SURVEY.md §6), and a torch-on-CPU ratio is a strawman — the honest
-single-chip metric is MFU: XLA-counted FLOPs per step × steps/s over the
-chip's peak.  `flops_per_step` asks the compiled executable itself
-(`compiled.cost_analysis()`), so the number tracks the real HLO after
-fusion/remat, not a hand model.  Note XLA counts rematerialized FLOPs too,
-so MFU here is *hardware* utilization (includes recompute), the same
-convention as the scaling-book's "hardware FLOPs utilization".
+single-chip metric is MFU.  The MFU *numerator* of record is the analytic
+jaxpr count (`nerrf_tpu.bench.flops.analytic_flops`): r5 measured
+`compiled.cost_analysis()["flops"]` on the TPU backend costing matmuls at
+their MXU-padded shapes AND ignoring scan trip counts — wrong in both
+directions, enough to put "MFU" at an impossible 195%.  `flops_per_step`
+here remains only as the recorded cross-check
+(`xla_cost_analysis_flops_per_step` in the bench line), and
+`chip_peak_tflops`/`mfu` supply the per-chip peaks for the ratio.
 """
 
 from __future__ import annotations

@@ -70,6 +70,16 @@ def _maybe_auto_register() -> None:
     global _AUTO_TRIED
     if _AUTO_TRIED or _SEGMENT_SUM_IMPL is not None:
         return
+    from jax._src import core as _core  # trace_state_clean left jax.core in 0.9
+
+    if not _core.trace_state_clean():
+        # First use is inside a jit trace: the probe must execute its smoke
+        # kernels for real (fetch-synced), which a tracing context cannot do
+        # — defer without setting _AUTO_TRIED so the next EAGER call probes.
+        # This trace's program uses the XLA fallback ops; steady-state
+        # processes (bench, training, pipeline warmup) all touch the ops
+        # eagerly first, so this only affects a cold jit-first flow.
+        return
     _AUTO_TRIED = True
     if os.environ.get("NERRF_NO_PALLAS") == "1":
         return

@@ -55,9 +55,10 @@ def save_checkpoint(path: str | Path, params, cfg: JointConfig,
         ckptr.save(path / "params", jax.device_get(params), force=True)
     meta = {
         "gnn": {"hidden": cfg.gnn.hidden, "num_layers": cfg.gnn.num_layers,
-                "dropout": cfg.gnn.dropout},
+                "dropout": cfg.gnn.dropout,
+                "aggregation": cfg.gnn.aggregation},
         "lstm": {"hidden": cfg.lstm.hidden, "num_layers": cfg.lstm.num_layers,
-                 "dropout": cfg.lstm.dropout},
+                 "dropout": cfg.lstm.dropout, "impl": cfg.lstm.impl},
         "fuse": cfg.fuse,
         "features": _feature_layout(),
     }

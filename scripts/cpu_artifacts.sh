@@ -1,0 +1,51 @@
+#!/bin/bash
+# Round-5 CPU-side artifact chain (single-core host: strictly serial).
+# Runs the artifact producers that do NOT need the accelerator, in
+# dependency order; each step is idempotent/overwrite-only and logged.
+# Usage: nohup bash scripts/cpu_artifacts.sh > /tmp/cpu_artifacts.log 2>&1 &
+set -u
+cd "$(dirname "$0")/.."
+log() { echo "[artifacts $(date +%H:%M:%S)] $*"; }
+
+# 1. probe checkpoint (skipped when a finished one exists — metrics.json is
+#    written after calibration, so its presence means the full pipeline ran)
+if [ ! -f runs/probe-corpus-cpu/metrics.json ]; then
+  log "1/6 probe-corpus-cpu training"
+  python -m nerrf_tpu.train.run --experiment probe-corpus-cpu \
+    --out runs/probe-corpus-cpu --platform cpu \
+    > /tmp/art_probe.log 2>&1
+  log "probe rc=$?"
+else
+  log "1/6 probe checkpoint present — skipping"
+fi
+
+# 2. warm-boot MTTR bench (needs the probe checkpoint)
+log "2/6 warmboot bench"
+python benchmarks/run_warmboot_bench.py \
+  --out benchmarks/results/warmboot.json > /tmp/art_warmboot.log 2>&1
+log "warmboot rc=$?"
+
+# 3. e2e daemon replay artifact (needs native/build/nerrf-trackerd)
+log "3/6 e2e daemon replay"
+python benchmarks/run_e2e_daemon.py \
+  --out benchmarks/results/e2e_daemon.json > /tmp/art_e2e.log 2>&1
+log "e2e rc=$?"
+
+# 4. leave-one-scenario-out generalization (4 probe trainings)
+log "4/6 LOSO eval"
+python benchmarks/run_loso_eval.py --platform cpu \
+  --out benchmarks/results/loso_eval.json > /tmp/art_loso.log 2>&1
+log "loso rc=$?"
+
+# 5. stream detector quality + calibrated checkpoint
+log "5/6 stream eval"
+python benchmarks/run_stream_eval.py --platform cpu \
+  --out benchmarks/results/stream_probe_cpu.json > /tmp/art_stream.log 2>&1
+log "stream rc=$?"
+
+# 6. stream+window fusion on slow-burn scenarios (needs 1 and 5)
+log "6/6 stream fusion"
+python benchmarks/run_stream_fusion.py \
+  --out benchmarks/results/stream_fusion.json > /tmp/art_fusion.log 2>&1
+log "fusion rc=$?"
+log "chain complete"

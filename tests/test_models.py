@@ -159,3 +159,69 @@ def test_nerrfnet_jit_recompile_free():
         ai = {k: jnp.asarray(v[i]) for k, v in ds.arrays.items()}
         fwd(params, *model_inputs(ai))
     assert fwd._cache_size() == n0 == 1
+
+
+def test_gnn_aggregation_paths_parity():
+    """dense_adj (one [N,N] matmul per layer) and segment (gather +
+    banded segment-mean) must compute the same aggregation — the bench
+    times the dense path, training checkpoints must load into either."""
+    import dataclasses
+
+    import jax
+
+    from nerrf_tpu.models.graphsage import GraphSAGEConfig, GraphSAGET
+
+    ds = _dataset()
+    gin = ("node_feat", "node_type", "node_aux", "node_mask", "edge_src",
+           "edge_dst", "edge_feat", "edge_mask")
+    args = tuple(np.asarray(ds.arrays[k][1]) for k in gin)
+    cfg_d = GraphSAGEConfig(hidden=32, num_layers=4, dropout=0.0,
+                            aggregation="dense_adj")
+    cfg_s = dataclasses.replace(cfg_d, aggregation="segment")
+    gd, gs = GraphSAGET(cfg_d), GraphSAGET(cfg_s)
+    p = gd.init(jax.random.PRNGKey(0), *args)["params"]
+    ps = gs.init(jax.random.PRNGKey(0), *args)["params"]
+    assert (jax.tree_util.tree_structure(p)
+            == jax.tree_util.tree_structure(ps))
+    od = gd.apply({"params": p}, *args)
+    os_ = gs.apply({"params": p}, *args)
+    for k in ("edge_logit", "node_logit"):
+        err = np.max(np.abs(np.asarray(od[k], np.float32)
+                            - np.asarray(os_[k], np.float32)))
+        assert err < 0.15, (k, err)  # bf16 reorder noise over 4 layers
+
+
+def test_lstm_impl_paths_parity():
+    """fused (one scan, both directions, hoisted input projections) and
+    rnn (flax RNN/OptimizedLSTMCell) must agree exactly in f32 on shared
+    params, including ragged lengths and an all-pad row."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from nerrf_tpu.models.lstm import ImpactLSTM, LSTMConfig
+
+    rng = np.random.default_rng(0)
+    B, T, F = 6, 20, 12
+    feat = rng.normal(size=(B, T, F)).astype(np.float32)
+    lengths = np.array([20, 13, 7, 1, 0, 19])
+    mask = np.zeros((B, T), bool)
+    for i, L in enumerate(lengths):
+        if L:
+            mask[i, T - L:] = True  # left-padded: valid suffix
+    feat = feat * mask[..., None]
+
+    cfg_f = LSTMConfig(hidden=16, num_layers=2, dropout=0.0,
+                       dtype=jnp.float32, impl="fused")
+    cfg_r = dataclasses.replace(cfg_f, impl="rnn")
+    mf, mr = ImpactLSTM(cfg_f), ImpactLSTM(cfg_r)
+    p = mf.init(jax.random.PRNGKey(0), feat, mask)["params"]
+    pr = mr.init(jax.random.PRNGKey(0), feat, mask)["params"]
+    assert (jax.tree_util.tree_structure(p)
+            == jax.tree_util.tree_structure(pr))
+    of = mf.apply({"params": p}, feat, mask)
+    orr = mr.apply({"params": p}, feat, mask)
+    for k in ("seq_logit", "seq_emb"):
+        err = np.max(np.abs(np.asarray(of[k]) - np.asarray(orr[k])))
+        assert err < 1e-4, (k, err)

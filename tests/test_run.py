@@ -44,6 +44,6 @@ def test_run_sharded_experiment_on_virtual_mesh(tmp_path):
     )
     cfg_path = tmp_path / "exp.json"
     small.save(cfg_path)
-    report = run_experiment(str(cfg_path), tmp_path / "out")
+    report = run_experiment(str(cfg_path), tmp_path / "out", calibrate=False)
     assert report["devices"] == 8
     assert report["steps_per_sec"] > 0
