@@ -1,0 +1,54 @@
+#!/bin/bash
+# Round-5 chip-queue CONTINUATION (steps 7-9 of scripts/tpu_queue.sh,
+# reordered).  Steps 1-6 landed before the tunnel wedged at 18:22; the
+# remaining chip work is re-ordered so the round's #1 deliverable — the
+# clean bench.py line of record (MFU + 4096 leg) — runs FIRST in the next
+# tunnel window instead of behind a ~40 min stream-eval.  Same probe gate
+# and the same attempt log (/tmp/tpu_queue.log) so the tunnel-evidence
+# chain stays in one file.
+#
+#   1/4. bench.py of record            → /tmp/bench_smoke.json
+#   2/4. chip-gated compiled-kernel test → pallas_tpu.log
+#   3/4. stream detector quality on chip → stream_probe_tpu.json
+#   4/4. m1 recovery rerun (the mid-queue wedge degraded the committed
+#        artifact's planner leg to CPU)  → m1_recovery.json
+cd "$(dirname "$0")/.."
+log() { echo "[queue $(date +%H:%M:%S)] $*" >> /tmp/tpu_queue.log; }
+log "continuation watcher started (r5b: bench-first reorder)"
+tpu_ok() {
+  python -c "
+import sys
+from nerrf_tpu.utils import probe_backend
+ok, detail, _ = probe_backend(timeout_sec=150)
+sys.exit(0 if ok and detail.startswith('tpu') else 1)
+" 2>/dev/null
+}
+wait_for_tpu() {
+  local n=0
+  while ! tpu_ok; do
+    n=$((n + 1))
+    log "tpu probe #$n failed (enumerate->compile->execute did not complete)"
+    sleep 120
+  done
+  log "TPU is up (fresh compile path verified after $n failed probes)"
+}
+log "1/4 bench.py of record (MFU + 4096-bucket leg)"
+wait_for_tpu
+timeout 3600 python bench.py > /tmp/bench_smoke.json 2> /tmp/bench_smoke.log
+log "bench rc=$?"
+log "2/4 chip-gated compiled-kernel test"
+wait_for_tpu
+NERRF_TEST_REAL_BACKEND=1 timeout 1200 python -m pytest \
+  tests/test_pallas_ops.py -q -k compiled_on_tpu > /tmp/pallas_tpu.log 2>&1
+log "pallas chip test rc=$?"
+log "3/4 stream detector quality + calibration on chip"
+wait_for_tpu
+timeout 2400 python benchmarks/run_stream_eval.py --steps 1500 \
+  --out benchmarks/results/stream_probe_tpu.json > /tmp/stream_tpu.log 2>&1
+log "stream quality rc=$?"
+log "4/4 m1 recovery rerun (device planner on chip)"
+wait_for_tpu
+timeout 1800 python benchmarks/run_recovery_bench.py --scale m1 \
+  --out benchmarks/results/m1_recovery.json > /tmp/recovery_m1.log 2>&1
+log "m1 recovery rc=$?"
+log "continuation queue done"
