@@ -26,10 +26,18 @@ Skip the torch leg with NERRF_BENCH_SKIP_TORCH=1 (vs_baseline then null).
 
 from __future__ import annotations
 
+import glob
 import json
 import os
+import re
 import sys
 import time
+
+
+def _round_of(path: str) -> int:
+    """Round number encoded in an artifact filename (``..._r<N>.json``)."""
+    m = re.search(r"_r(\d+)\.json$", path)
+    return int(m.group(1)) if m else -1
 
 
 def main() -> None:
@@ -407,10 +415,12 @@ def main() -> None:
                            "benchmarks", "results")
 
     def _j100():
-        # newest round first
-        p = next((q for q in (
-            os.path.join(art_dir, f"joint100h_r{n}.json")
-            for n in (4, 3, 2)) if os.path.exists(q)), "")
+        # newest round first (scan, don't enumerate: a hardcoded round list
+        # silently dropped the r5 chip-trained artifact from the line of
+        # record until it was widened)
+        cands = sorted(glob.glob(os.path.join(art_dir, "joint100h_r*.json")),
+                       key=_round_of, reverse=True)
+        p = cands[0] if cands else ""
         if not p:
             return None
         r = json.load(open(p))
@@ -428,10 +438,15 @@ def main() -> None:
         # (current code, small model), then older chip/CPU rounds — the r2
         # file predates the mutation gate + hardened corpus and would
         # misreport the current system
-        p = next((q for q in (
-            os.path.join(art_dir, name)
-            for name in ("adversarial_r4.json", "adversarial_r3.json",
-                         "adversarial_probe_cpu.json", "adversarial_r2.json"))
+        # rounds <= 2 predate the mutation gate + hardened corpus and would
+        # misreport the current system: they rank BELOW the probe artifact
+        rounds = sorted(
+            (q for q in glob.glob(os.path.join(art_dir, "adversarial_r*.json"))
+             if _round_of(q) > 2),
+            key=_round_of, reverse=True)
+        p = next((q for q in rounds + [
+            os.path.join(art_dir, "adversarial_probe_cpu.json"),
+            os.path.join(art_dir, "adversarial_r2.json")]
             if os.path.exists(q)), "")
         if not p:
             return None
