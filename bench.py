@@ -274,6 +274,11 @@ def main() -> None:
             big_tflops, big_mfu = mfu(big_flops, big_sps, jax.devices()[0])
             big_bucket = {
                 "shape": "4096n/8192e/128seq", "batch": big_cfg.batch_size,
+                # the 4096 bucket routes `auto` differently from the
+                # flagship shape (fused past DENSE_ADJ_MAX_NODES) — stamp
+                # the mode this leg's numbers belong to
+                "gnn_aggregation": big_cfg.model.gnn.resolved_aggregation(
+                    big_ds_cfg.graph.max_nodes),
                 "steps_per_sec": round(big_sps, 3),
                 "model_flops_per_step":
                     round(big_flops) if big_flops else None,
@@ -506,9 +511,12 @@ def main() -> None:
 
         kernel_path = active_impls()
         # the flagship GNN's 28-layer aggregation no longer dispatches
-        # segment kernels at all under dense_adj — record the mode so the
-        # kernel attribution can't silently mislead (r2 verdict weak #5)
-        kernel_path["gnn_aggregation"] = cfg.model.gnn.resolved_aggregation()
+        # segment kernels at all under dense_adj/fused — record the mode
+        # (at the flagship node bucket: `auto` routes by bucket size) so
+        # the kernel attribution can't silently mislead (r2 verdict weak
+        # #5); the 4096 leg stamps its own mode in big_bucket
+        kernel_path["gnn_aggregation"] = cfg.model.gnn.resolved_aggregation(
+            cap["max_nodes"])
         kernel_path["lstm_impl"] = cfg.model.lstm.resolved_impl()
     except Exception:
         kernel_path = None

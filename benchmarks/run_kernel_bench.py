@@ -1,0 +1,253 @@
+#!/usr/bin/env python3
+"""Per-bucket aggregation microbenchmark: {segment, dense_adj, fused}.
+
+The 28-layer GraphSAGE-T's neighbor aggregation is the hot op of every
+forward the system runs, and `GraphSAGEConfig.aggregation="auto"` must route
+each node bucket to the shape that actually wins there — a threshold that
+should come from measured numbers, not the r5 anecdote.  This bench sweeps
+the three parity-tested aggregation shapes across the deployment buckets and
+records, per (mode, bucket):
+
+  * per-layer aggregation time (one aggregation call == one layer's work),
+  * the one-off per-forward precompute cost the mode amortizes over the
+    28 layers (adjacency build / sorted-view normalization),
+  * sequential kernel launches per layer — the quantity the r5 profile
+    showed dominating at ~0.27 ms fixed cost per launch: segment ≈ 6
+    (2 gathers + 2×2 segment-mean sums), dense_adj = 1 matmul, fused = 1
+    `sage_aggregate` kernel,
+  * `kernel_path` (ops.active_impls()) so every number is attributed to the
+    implementation that actually served it (TpuGraphs' lesson, arXiv:
+    2308.13490: a runtime number without its kernel config is unusable).
+
+Off-TPU the wall-clock columns are degraded (XLA-CPU serves all modes; the
+artifact says so) but the kernel-count attribution and the O(N²)-vs-O(E)
+work ratio still hold; an `interpret_parity` leg additionally runs the fused
+Pallas kernel in interpreter mode at the smallest bucket to pin its
+numerics to the segment oracle inside the same artifact.  The `auto`
+routing threshold (`DENSE_ADJ_MAX_NODES`, nerrf_tpu/models/graphsage.py)
+cites the artifact this script writes.
+
+Usage:
+  python benchmarks/run_kernel_bench.py --platform cpu \
+      --out benchmarks/results/kernel_bench_cpu.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+import numpy as np
+
+# sequential kernel launches per layer per mode — the launch-overhead
+# attribution (segment: fwd gather + fwd sum + fwd denom + rev gather +
+# rev sum + rev denom; the one-kernel modes are the point of this PR)
+KERNELS_PER_LAYER = {"segment": 6, "dense_adj": 1, "fused": 1}
+
+
+def _log(m):
+    print(f"[kernel-bench] {m}", file=sys.stderr, flush=True)
+
+
+def _graph(n, e, seed):
+    """Synthetic window graph in the builder's layout: dst-sorted edges,
+    causality-style weights with a masked tail (like padded edge slots)."""
+    rng = np.random.default_rng(seed)
+    src = rng.integers(0, n, e).astype(np.int32)
+    dst = np.sort(rng.integers(0, n, e)).astype(np.int32)
+    w = rng.uniform(0.1, 1.1, e).astype(np.float32)
+    w[int(e * 0.9):] = 0.0  # ~10% padded slots
+    return src, dst, w
+
+
+def _time_fn(fn, arg, iters, fetch):
+    t0 = time.perf_counter()
+    fetch(fn(arg))
+    compile_s = time.perf_counter() - t0
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        fetch(fn(arg))
+        times.append(time.perf_counter() - t0)
+    return float(np.median(times) * 1e3), round(compile_s, 3)
+
+
+def bench_bucket(n, e, hidden, iters, dtype, fetch, report_rows):
+    import jax
+    import jax.numpy as jnp
+
+    from nerrf_tpu.models.graphsage import GraphSAGEConfig, fused_edge_views
+    from nerrf_tpu.ops import gather_rows, sage_aggregate, segment_mean
+
+    src_np, dst_np, w_np = _graph(n, e, seed=n)
+    order_np = np.argsort(src_np)
+    src = jnp.asarray(src_np)
+    dst = jnp.asarray(dst_np)
+    w32 = jnp.asarray(w_np)
+    msg = jnp.asarray(
+        np.random.default_rng(n + 1).normal(size=(n, hidden)), dtype)
+    w_dt = w32.astype(dtype)
+
+    # --- segment: the 6-kernel per-layer path (SageBlock's shape) -----------
+    src_sorted = jnp.asarray(src_np[order_np])
+    dst_srcorder = jnp.asarray(dst_np[order_np])
+    w_s = jnp.asarray(w_np[order_np]).astype(dtype)
+
+    @jax.jit
+    def agg_segment(m):
+        a_f = segment_mean(gather_rows(m, src), dst, n, weights=w_dt,
+                           sorted_ids=True)
+        a_r = segment_mean(gather_rows(m, dst_srcorder), src_sorted, n,
+                           weights=w_s, sorted_ids=True)
+        return a_f + a_r
+
+    # --- shared per-forward precompute: THE model's view builder ------------
+    # (nerrf_tpu/models/graphsage.py fused_edge_views — timing a replica
+    # would let the routing artifact drift from the shape the model runs)
+    _views = jax.jit(lambda w: fused_edge_views(src, dst, w, n))
+    fused_build_ms, _ = _time_fn(lambda w: _views(w)[0][-1], w32, iters,
+                                 fetch)
+    edges, _d_f, _d_r, inv_f, inv_r = _views(w32)
+
+    # --- dense_adj: one [N,N]@[N,H] matmul per layer ------------------------
+    @jax.jit
+    def _build_adj(w):
+        flat = dst.astype(jnp.int32) * n + src.astype(jnp.int32)
+        w_raw = jax.ops.segment_sum(w, flat, num_segments=n * n
+                                    ).reshape(n, n)
+        return (w_raw * inv_f[:, None] + w_raw.T * inv_r[:, None]
+                ).astype(dtype)
+
+    dense_build_ms, _ = _time_fn(_build_adj, w32, iters, fetch)
+    adj = _build_adj(w32)
+    agg_dense = jax.jit(lambda m: adj @ m)
+
+    # --- fused: one sage_aggregate kernel per layer -------------------------
+    agg_fused = jax.jit(lambda m: sage_aggregate(m, *edges, n))
+
+    modes = {}
+    for name, fn in (("segment", agg_segment), ("dense_adj", agg_dense),
+                     ("fused", agg_fused)):
+        ms, compile_s = _time_fn(fn, msg, iters, fetch)
+        modes[name] = {
+            "ms_per_layer": round(ms, 3),
+            "compile_s": compile_s,
+            "kernels_per_layer": KERNELS_PER_LAYER[name],
+        }
+        _log(f"  n={n} {name}: {ms:.3f} ms/layer "
+             f"({KERNELS_PER_LAYER[name]} kernel(s)/layer)")
+    modes["dense_adj"]["per_forward_build_ms"] = round(dense_build_ms, 3)
+    modes["dense_adj"]["adj_bytes"] = n * n * np.dtype(
+        np.float32 if dtype == jnp.float32 else np.float16).itemsize
+    modes["fused"]["per_forward_build_ms"] = round(fused_build_ms, 3)
+
+    report_rows.append({
+        "nodes": n, "edges": e, "hidden": hidden,
+        "auto_resolves_to": GraphSAGEConfig().resolved_aggregation(n),
+        "modes": modes,
+    })
+
+
+def interpret_parity(hidden):
+    """Run the fused Pallas kernel in interpreter mode at the smallest
+    bucket against the XLA composition that serves production off-TPU
+    (ops.segment.sage_aggregate_xla) over the MODEL's own view builder, so
+    the artifact carries the kernel's numerics alongside its timings
+    (degraded-CPU acceptance path)."""
+    import jax.numpy as jnp
+
+    from nerrf_tpu.models.graphsage import fused_edge_views
+    from nerrf_tpu.ops import pallas_segment
+    from nerrf_tpu.ops.segment import sage_aggregate_xla
+
+    n, e = 256, 512
+    src_np, dst_np, w_np = _graph(n, e, seed=99)
+    edges, _, _, _, _ = fused_edge_views(
+        jnp.asarray(src_np), jnp.asarray(dst_np), jnp.asarray(w_np), n)
+    msg = jnp.asarray(
+        np.random.default_rng(100).normal(size=(n, hidden)), jnp.float32)
+
+    got = pallas_segment.sage_aggregate_fused(msg, *edges, n, True)
+    want = sage_aggregate_xla(msg, *edges, n)
+    err = float(jnp.max(jnp.abs(got - want)))
+    _log(f"interpret-mode fused parity at 256n/512e: max_abs_err={err:.2e}")
+    return {"nodes": n, "edges": e, "max_abs_err": err,
+            "pallas_calls_per_layer": 1, "ok": bool(err < 1e-4)}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--out", default="benchmarks/results/kernel_bench_cpu.json")
+    ap.add_argument("--platform", default=None,
+                    help="force a JAX platform before backend init "
+                         "(env vars can't override the axon sitecustomize)")
+    ap.add_argument("--buckets", default="256,1024,4096",
+                    help="comma-separated node buckets (edges = 2×nodes, "
+                         "the builder's capacity ratio)")
+    ap.add_argument("--hidden", type=int, default=160,
+                    help="message width (flagship hidden=160)")
+    ap.add_argument("--iters", type=int, default=3)
+    args = ap.parse_args(argv)
+
+    from nerrf_tpu.utils import enable_compilation_cache, fetch_value
+
+    enable_compilation_cache()
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+    import jax.numpy as jnp
+
+    from nerrf_tpu.ops.segment import active_impls
+
+    t0 = time.time()
+    backend = jax.default_backend()
+    dtype = jnp.bfloat16 if backend == "tpu" else jnp.float32
+    _log(f"backend={backend} dtype={jnp.dtype(dtype).name}")
+
+    rows = []
+    for n in [int(b) for b in args.buckets.split(",")]:
+        bench_bucket(n, 2 * n, args.hidden, args.iters, dtype,
+                     fetch_value, rows)
+
+    report = {
+        "backend": backend,
+        # off-TPU every mode is served by XLA-CPU: wall-clock columns rank
+        # shapes on the wrong machine, so the chip-routing evidence is the
+        # kernels_per_layer × ~0.27 ms launch cost + the work-ratio scaling
+        # across buckets; re-run on chip for times of record
+        "degraded": backend != "tpu",
+        "dtype": jnp.dtype(dtype).name,
+        "iters": args.iters,
+        "kernel_path": active_impls(),
+        "buckets": rows,
+        "interpret_parity": interpret_parity(args.hidden),
+        "routing": {
+            "auto_rule": "tpu: dense_adj if nodes <= dense_adj_max_nodes "
+                         "else fused; off-tpu: segment",
+            "dense_adj_max_nodes_consumer":
+                "nerrf_tpu/models/graphsage.py DENSE_ADJ_MAX_NODES "
+                "(cites this artifact)",
+        },
+        "provenance": "python benchmarks/run_kernel_bench.py",
+        "wall_seconds": round(time.time() - t0, 1),
+    }
+    out = Path(args.out)
+    out.parent.mkdir(parents=True, exist_ok=True)
+    out.write_text(json.dumps(report, indent=2) + "\n")
+    _log(f"wrote {out}")
+    print(json.dumps({
+        "buckets": {r["nodes"]: {m: r["modes"][m]["ms_per_layer"]
+                                 for m in r["modes"]} for r in rows},
+        "degraded": report["degraded"],
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

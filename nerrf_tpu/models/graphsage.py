@@ -31,7 +31,55 @@ import jax
 import jax.numpy as jnp
 
 from nerrf_tpu.graph.builder import AUX_VOCAB
-from nerrf_tpu.ops import gather_rows, segment_mean
+from nerrf_tpu.ops import gather_rows, sage_aggregate, segment_mean
+
+# Where `auto` stops paying for the dense adjacency on TPU.  At N ≤ this the
+# whole per-layer aggregate is one [N,N]@[N,H] MXU matmul and the O(N²·H)
+# work is cheap enough to win on launch overhead alone; past it the [N,N]
+# materialization (64 MB f32 at 4096) and the quadratic FLOPs lose to the
+# fused O(E) kernel, which also issues one kernel per layer.  Threshold from
+# benchmarks/results/kernel_bench_cpu.json (`python
+# benchmarks/run_kernel_bench.py` sweeps {segment, dense_adj, fused} ×
+# bucket ∈ {256, 1024, 4096}): dense_adj work grows 16× per bucket step
+# while fused grows ~2× (O(N²) vs O(E), measured per-layer times in the
+# artifact), crossing between the 1024 corpus bucket and the 4096 deployed
+# bucket.  Re-run the sweep on chip and move this if the measured crossover
+# disagrees.
+DENSE_ADJ_MAX_NODES = 1024
+
+
+def fused_edge_views(edge_src, edge_dst, w32, num_nodes):
+    """Per-forward normalized edge views for the one-kernel-per-layer
+    aggregation modes — THE single definition of the precompute both
+    `GraphSAGET` and the kernel microbenchmark
+    (benchmarks/run_kernel_bench.py) run, so the artifact the `auto`
+    routing threshold cites cannot drift from the shape the model
+    executes.
+
+    Returns ``(edges, d_fwd, d_rev, inv_f, inv_r)`` where ``edges`` is the
+    8-tuple `ops.sage_aggregate` takes (both sorted edge orders, each
+    direction's pre-normalized weights ``ŵ = w·inv`` in both orders) and
+    ``d``/``inv`` are the per-node weight totals / safe inverses (the
+    dense path's row/col normalizations; the e_emb/bias folding reuses
+    them).  ``edge_dst`` must be the builder's sorted-by-dst ids and
+    ``w32`` float32 edge weights with masked edges already zeroed."""
+    d_fwd = jax.ops.segment_sum(w32, edge_dst, num_segments=num_nodes,
+                                indices_are_sorted=True)
+    d_rev = jax.ops.segment_sum(w32, edge_src, num_segments=num_nodes)
+    inv_f = 1.0 / jnp.maximum(d_fwd, 1e-6)
+    inv_r = 1.0 / jnp.maximum(d_rev, 1e-6)
+    src_order = jnp.argsort(edge_src)
+    wf_d = w32 * jnp.take(inv_f, edge_dst)
+    wr_d = w32 * jnp.take(inv_r, edge_src)
+    edges = (edge_dst,                          # nondecreasing dst ids
+             edge_src,                          # message source per edge
+             jnp.take(edge_src, src_order),     # nondecreasing src ids
+             jnp.take(edge_dst, src_order),     # message source, src order
+             wf_d,
+             jnp.take(wf_d, src_order),
+             jnp.take(wr_d, src_order),
+             wr_d)
+    return edges, d_fwd, d_rev, inv_f, inv_r
 
 
 @dataclasses.dataclass(frozen=True)
@@ -40,15 +88,19 @@ class GraphSAGEConfig:
     num_layers: int = 28
     dropout: float = 0.1
     dtype: Any = jnp.bfloat16
-    # "dense_adj": per-layer aggregation is ONE [N,N]@[N,H] matmul against a
-    # row-normalized adjacency built once per forward — the TPU-shaped path
-    # (pure MXU work; r5 measured ~0.27 ms fixed cost per sequential
-    # kernel on the chip runtime, and the segment path issues ~6 kernels
-    # per layer where this issues 1: 163→50 ms/step flagship, 1.72 s→0.10 s
-    # at the 4096 deployed bucket).  "segment": the original per-layer
-    # gather + banded-segment-mean path (same math — parity-tested; the
-    # O(E) shape that wins where O(N^2) MXU work does not pay, e.g. CPU).
-    # "auto" (default): dense_adj on the TPU backend, segment elsewhere.
+    # Three parity-tested aggregation shapes (docs/kernel-paths.md):
+    # "fused": ONE Pallas kernel per layer, O(E) work — blocked-CSR bands
+    # over the builder's dst-sorted edges plus the per-window src-sorted
+    # view, gather + weight + scatter-accumulate fused in VMEM
+    # (ops.sage_aggregate; XLA composition with identical semantics
+    # off-TPU).  "dense_adj": ONE [N,N]@[N,H] matmul per layer against a
+    # normalized adjacency built once per forward — pure MXU work, O(N²·H);
+    # r5 measured ~0.27 ms fixed cost per sequential kernel on the chip
+    # runtime, and replacing the segment path's ~6 kernels/layer with 1
+    # took 163→50 ms/step flagship.  "segment": per-layer gather +
+    # banded-segment-mean — the portable parity oracle.  "auto" (default):
+    # on TPU, dense_adj up to DENSE_ADJ_MAX_NODES and fused above it;
+    # segment elsewhere.
     aggregation: str = "auto"
 
     @property
@@ -56,14 +108,26 @@ class GraphSAGEConfig:
         """A CPU-test-sized variant (same code path, tiny shapes)."""
         return dataclasses.replace(self, hidden=32, num_layers=4)
 
-    def resolved_aggregation(self) -> str:
+    def resolved_aggregation(self, num_nodes: int | None = None) -> str:
         """The aggregation mode the forward actually uses on this
         process's default backend — the single definition of the "auto"
         rule (the model and the bench's kernel_path attribution both call
-        this, so the artifact cannot drift from the compute)."""
+        this, so the artifact cannot drift from the compute).  ``num_nodes``
+        is the padded node bucket: on TPU, `auto` keeps the dense adjacency
+        where O(N²) MXU work still wins (≤ DENSE_ADJ_MAX_NODES, measured —
+        see the constant) and routes bigger buckets to the fused O(E)
+        kernel; with no bucket given it assumes the large-bucket answer."""
         if self.aggregation != "auto":
+            if self.aggregation not in ("fused", "dense_adj", "segment"):
+                raise ValueError(
+                    f"unknown aggregation {self.aggregation!r}; expected "
+                    "'auto', 'fused', 'dense_adj' or 'segment'")
             return self.aggregation
-        return "dense_adj" if jax.default_backend() == "tpu" else "segment"
+        if jax.default_backend() != "tpu":
+            return "segment"
+        if num_nodes is not None and num_nodes <= DENSE_ADJ_MAX_NODES:
+            return "dense_adj"
+        return "fused"
 
 
 class SageBlock(nn.Module):
@@ -80,12 +144,26 @@ class SageBlock(nn.Module):
 
     @nn.compact
     def __call__(self, h, e_emb, edge_src, edge_dst, edge_w, num_nodes,
-                 rev_view=None, dense_view=None):
+                 rev_view=None, dense_view=None, fused_view=None):
         hn = nn.LayerNorm(dtype=self.dtype, name="ln")(h)
         msg = nn.Dense(self.hidden, dtype=self.dtype, name="w_msg")(hn)
         dir_bias = self.param(
             "dir_bias", nn.initializers.zeros, (2, self.hidden), jnp.float32
         ).astype(self.dtype)
+        if fused_view is not None:
+            # fused aggregation: the whole bidirectional weighted mean of
+            # `msg` is ONE sage_aggregate call (a single Pallas kernel on
+            # TPU) over GraphSAGET's pre-normalized sorted edge views —
+            # same decomposition as the dense path below (e_emb's mean in
+            # c_sum, the empty-segment zeroing in s_f/s_r), but O(E) work
+            # and no [N,N] materialization
+            edges, c_sum, s_f, s_r = fused_view
+            agg = (sage_aggregate(msg, *edges, num_nodes) + c_sum
+                   + dir_bias[0] * s_f[:, None] + dir_bias[1] * s_r[:, None])
+            upd = nn.Dense(self.hidden, dtype=self.dtype, name="w_self")(
+                jnp.concatenate([hn, agg], axis=-1)
+            )
+            return h + nn.gelu(upd)
         if dense_view is not None:
             # dense-adjacency aggregation: same weighted-mean math as the
             # segment path below, but the whole bidirectional aggregate is
@@ -159,36 +237,43 @@ class GraphSAGET(nn.Module):
         e_emb = nn.Dense(cfg.hidden, dtype=dt, name="edge_enc")(edge_feat.astype(dt))
         e_emb = nn.gelu(e_emb)
         # causality weight (edge_feat[:, 12]) gates messages; masked edges → 0
-        edge_w = (edge_feat[:, 12] + 0.1) * edge_mask.astype(jnp.float32)
-        edge_w = edge_w.astype(dt)
+        w32 = (edge_feat[:, 12] + 0.1) * edge_mask.astype(jnp.float32)
+        edge_w = w32.astype(dt)
 
-        rev_view = dense_view = None
-        agg_mode = cfg.resolved_aggregation()
+        rev_view = dense_view = fused_view = None
+        agg_mode = cfg.resolved_aggregation(n)
+        if agg_mode in ("dense_adj", "fused"):
+            # Per-forward aggregation state shared by all layers, so each
+            # of the 28 layers costs ONE kernel (a matmul or the fused
+            # Pallas scatter) — no gather/scatter/normalize on the layer
+            # critical path at all.  fused_edge_views is the shared
+            # precompute (normalizations + both sorted pre-weighted edge
+            # orders; the fused kernel's forward rides one pair, its
+            # adjoint the exchanged pair, so fwd AND bwd stay at one
+            # kernel per layer); the (layer-invariant) e_emb term folds
+            # into c_sum, and s_f/s_r carry the empty-segment zeroing the
+            # segment path gets from its max(denom, eps) guard.
+            edges, d_fwd, d_rev, inv_f, inv_r = fused_edge_views(
+                edge_src, edge_dst, w32, n)
+            we = w32[:, None] * e_emb.astype(jnp.float32)
+            c_f = jax.ops.segment_sum(we, edge_dst, num_segments=n,
+                                      indices_are_sorted=True)
+            c_r = jax.ops.segment_sum(we, edge_src, num_segments=n)
+            c_sum = (c_f * inv_f[:, None] + c_r * inv_r[:, None]).astype(dt)
+            s_f = (d_fwd * inv_f).astype(dt)
+            s_r = (d_rev * inv_r).astype(dt)
         if agg_mode == "dense_adj":
-            # Per-forward dense aggregation state, shared by all layers.
-            # One [E]→[N·N] scatter builds the raw weighted adjacency; both
-            # directions' weighted-mean normalizations are its row/col
-            # sums, and the (layer-invariant) e_emb term folds into c_sum.
-            # After this, each of the 28 layers costs ONE matmul — no
-            # gather/scatter on the layer critical path at all.
-            w32 = edge_w.astype(jnp.float32)
+            # One [E]→[N·N] scatter builds the raw weighted adjacency whose
+            # normalized form serves every layer as one [N,N]@[N,H] matmul.
             flat = edge_dst.astype(jnp.int32) * n + edge_src.astype(jnp.int32)
             w_raw = jax.ops.segment_sum(
                 w32, flat, num_segments=n * n).reshape(n, n)
-            d_fwd = w_raw.sum(axis=1)   # total in-weight per dst node
-            d_rev = w_raw.sum(axis=0)   # total out-weight per src node
-            inv_f = 1.0 / jnp.maximum(d_fwd, 1e-6)
-            inv_r = 1.0 / jnp.maximum(d_rev, 1e-6)
             adj = (w_raw * inv_f[:, None]
                    + w_raw.T * inv_r[:, None]).astype(dt)
-            we = w32[:, None] * e_emb.astype(jnp.float32)
-            c_f = jax.ops.segment_sum(we, edge_dst, num_segments=n)
-            c_r = jax.ops.segment_sum(we, edge_src, num_segments=n)
-            c_sum = (c_f * inv_f[:, None] + c_r * inv_r[:, None]).astype(dt)
-            dense_view = (adj, c_sum,
-                          (d_fwd * inv_f).astype(dt),
-                          (d_rev * inv_r).astype(dt))
-        else:
+            dense_view = (adj, c_sum, s_f, s_r)
+        elif agg_mode == "fused":
+            fused_view = (edges, c_sum, s_f, s_r)
+        elif agg_mode == "segment":
             # src-sorted edge view, computed once and shared by every layer:
             # with it the reverse aggregation also declares sorted ids and
             # the banded Pallas kernel serves both directions (one [E]
@@ -200,11 +285,14 @@ class GraphSAGET(nn.Module):
                 jnp.take(e_emb, src_order, axis=0),
                 jnp.take(edge_w, src_order),
             )
+        else:
+            raise ValueError(f"unknown aggregation mode {agg_mode!r}")
 
         for i in range(cfg.num_layers):
             h = SageBlock(cfg.hidden, dtype=dt, name=f"block_{i}")(
                 h, e_emb, edge_src, edge_dst, edge_w, n,
-                rev_view=rev_view, dense_view=dense_view
+                rev_view=rev_view, dense_view=dense_view,
+                fused_view=fused_view
             )
             h = h * node_mask[:, None].astype(dt)
 

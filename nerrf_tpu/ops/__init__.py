@@ -1,3 +1,8 @@
-from nerrf_tpu.ops.segment import segment_sum, segment_mean, gather_rows
+from nerrf_tpu.ops.segment import (
+    gather_rows,
+    sage_aggregate,
+    segment_mean,
+    segment_sum,
+)
 
-__all__ = ["segment_sum", "segment_mean", "gather_rows"]
+__all__ = ["segment_sum", "segment_mean", "gather_rows", "sage_aggregate"]

@@ -19,6 +19,38 @@ import orbax.checkpoint as ocp
 from nerrf_tpu.models import GraphSAGEConfig, JointConfig, LSTMConfig
 
 
+# Sidecar schema version, stamped into every checkpoint and validated at
+# load.  The feature-dim stamp below catches *known* drift axes (node/edge/
+# seq widths); the version catches everything else — bump it whenever the
+# meaning of stamped fields or the param-tree layout changes such that old
+# checkpoints must not load silently.  v2: r4 feature stamp era + the
+# three-way aggregation config ("fused" joins segment/dense_adj — same
+# param tree, so no bump needed for it; recorded here for the audit trail).
+SCHEMA_VERSION = 2
+# the oldest stamped schema this code still loads: raise this floor (not
+# just SCHEMA_VERSION) when a change means older checkpoints must not load
+# silently — only a floor can actually reject them
+MIN_SCHEMA_VERSION = 2
+
+
+def _check_schema_version(meta: dict, path: Path) -> None:
+    got = meta.get("schema_version")
+    if got is None:
+        # legacy unstamped sidecar: falls through to the feature-layout
+        # check, which produces its own actionable retrain message
+        return
+    if got > SCHEMA_VERSION:
+        raise ValueError(
+            f"retrain or upgrade: checkpoint {path} carries sidecar schema "
+            f"v{got}, this code writes v{SCHEMA_VERSION} — it was saved by "
+            f"a newer version of the code")
+    if got < MIN_SCHEMA_VERSION:
+        raise ValueError(
+            f"retrain: checkpoint {path} carries sidecar schema v{got}, "
+            f"older than the oldest supported v{MIN_SCHEMA_VERSION} — its "
+            f"layout predates changes this code cannot load")
+
+
 def _feature_layout() -> dict:
     """The input-feature layout the current code produces.  Stamped into
     every sidecar and verified at load: NODE_FEATURE_DIM moved 22→24 in r4
@@ -61,6 +93,7 @@ def save_checkpoint(path: str | Path, params, cfg: JointConfig,
                  "dropout": cfg.lstm.dropout, "impl": cfg.lstm.impl},
         "fuse": cfg.fuse,
         "features": _feature_layout(),
+        "schema_version": SCHEMA_VERSION,
     }
     if calibration:
         # held-out-calibrated operating points (e.g. node_threshold: the
@@ -74,6 +107,7 @@ def save_checkpoint(path: str | Path, params, cfg: JointConfig,
 def load_checkpoint(path: str | Path) -> Tuple[dict, JointConfig]:
     path = Path(path).absolute()
     meta = json.loads((path / "model_config.json").read_text())
+    _check_schema_version(meta, path)
     _check_feature_layout(meta, path, keys=("node", "edge", "seq"))
     cfg = JointConfig(
         gnn=GraphSAGEConfig(**meta["gnn"]),
@@ -100,7 +134,16 @@ def save_stream_checkpoint(path: str | Path, params, cfg,
     the calibrated per-event operating threshold travelling alongside the
     weights exactly like the joint model's node_threshold (VERDICT r3 item
     5: a stream head without an operating point only ever reports best-F1,
-    which is an oracle number no deployment can reproduce)."""
+    which is an oracle number no deployment can reproduce).
+
+    Calibration-space contract: ``stream_event_threshold`` lives in RAW
+    LOGIT space (best_f1 sweeps event_logits, never sigmoided) — unlike the
+    joint model's ``node_threshold``, which is a probability.  The sidecar
+    records this explicitly as ``stream_event_threshold_space`` so a
+    consumer mirroring node_threshold usage cannot mis-apply the cut (r4
+    advisor); if the caller's calibration dict carries the threshold but
+    omits the space, ``"logit"`` is stamped in here (the only space any
+    producer in this repo writes)."""
     import jax.numpy as jnp
 
     path = Path(path).absolute()
@@ -114,8 +157,12 @@ def save_stream_checkpoint(path: str | Path, params, cfg,
                    "dropout": cfg.dropout, "remat": cfg.remat,
                    "dtype": jnp.dtype(cfg.dtype).name},
         "features": {"stream": STREAM_FEATURE_DIM},
+        "schema_version": SCHEMA_VERSION,
     }
     if calibration:
+        if "stream_event_threshold" in calibration:
+            calibration = {"stream_event_threshold_space": "logit",
+                           **calibration}
         meta["calibration"] = calibration
     (path / "stream_config.json").write_text(json.dumps(meta, indent=2))
 
@@ -128,6 +175,7 @@ def load_stream_checkpoint(path: str | Path):
 
     path = Path(path).absolute()
     meta = json.loads((path / "stream_config.json").read_text())
+    _check_schema_version(meta, path)
     from nerrf_tpu.data.stream import STREAM_FEATURE_DIM
     got = (meta.get("features") or {}).get("stream")
     if got is not None and got != STREAM_FEATURE_DIM:

@@ -418,6 +418,16 @@ def train_nerrfnet(
     rng, init_rng = jax.random.split(rng)
     state = init_state(model, cfg, train_ds.arrays, init_rng)
     n = len(train_ds)
+    if log:
+        # the same kernel attribution the bench artifacts carry, stamped
+        # into the training log: a steps/s claim from this run is only
+        # interpretable against the aggregation mode + kernels that served
+        # it (the `auto` rule routes by node bucket and backend)
+        from nerrf_tpu.ops.segment import active_impls
+
+        log(f"gnn aggregation="
+            f"{cfg.model.gnn.resolved_aggregation(train_ds.arrays['node_feat'].shape[1])} "
+            f"kernel_path={active_impls()}")
     # HBM-resident + device-scheduled fast path when the dataset fits;
     # stream batches from host otherwise
     resident = _fits_resident(train_ds.arrays)

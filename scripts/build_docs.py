@@ -35,9 +35,9 @@ REPO = Path(__file__).resolve().parents[1]
 DOCS = REPO / "docs"
 
 # page order for the sidebar (index first, then the operator's journey)
-ORDER = ["index", "quick-start", "architecture", "models", "planner",
-         "rollback", "ingest", "scaling", "configuration", "operations",
-         "benchmarks"]
+ORDER = ["index", "quick-start", "architecture", "models", "kernel-paths",
+         "planner", "rollback", "ingest", "scaling", "configuration",
+         "operations", "benchmarks"]
 
 _CSS = """
 :root { --fg:#1a1f24; --bg:#ffffff; --accent:#0b63c5; --muted:#5a6572;
@@ -314,8 +314,11 @@ def build(out_dir: Path) -> list[Path]:
         "const SEARCH_INDEX = " + json.dumps(index) + ";\n")
     written = [out_dir / "search_index.js"]
     for name in order:
+        # no escapes inside f-string expressions: 3.10 rejects them at
+        # parse time (PEP 701 only lands in 3.12)
+        active = ' class="active"'
         nav = "\n".join(
-            f'<a href="{n}.html"{" class=\"active\"" if n == name else ""}>'
+            f'<a href="{n}.html"{active if n == name else ""}>'
             f"{html.escape(titles[n])}</a>" for n in order)
         body = md_to_html(pages[name])
         doc = f"""<!doctype html>

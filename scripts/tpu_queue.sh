@@ -5,15 +5,16 @@
 # eval), then the evals, then the benchmark of record last so it exercises
 # warm compilation caches.
 #
-#   1/9. joint-100h training on the r4+ corpus     → runs/joint-100h
-#   2/9. joint-dense training (4096n/8192e bucket) → runs/joint-dense
-#   3/9. adversarial eval vs the 100h checkpoint   → adversarial_r5.json
-#   4/9. graph capacity + Pallas crossover         → graph_capacity.json
-#   5/9. planner throughput probe                  → mcts_tpu.log
-#   6/9. recovery benches (device planner)         → m{0,1}_recovery.json
-#   7/9. stream detector quality + calibration     → stream_probe_tpu.json
-#   8/9. chip-gated compiled-kernel test           → pallas_tpu.log
-#   9/9. bench.py smoke (MFU + 4096-bucket leg)    → /tmp/bench_smoke.json
+#   1/10. joint-100h training on the r4+ corpus     → runs/joint-100h
+#   2/10. joint-dense training (4096n/8192e bucket) → runs/joint-dense
+#   3/10. adversarial eval vs the 100h checkpoint   → adversarial_r5.json
+#   4/10. graph capacity + Pallas crossover         → graph_capacity.json
+#   5/10. aggregation kernel microbench             → kernel_bench_tpu.json
+#   6/10. planner throughput probe                  → mcts_tpu.log
+#   7/10. recovery benches (device planner)         → m{0,1}_recovery.json
+#   8/10. stream detector quality + calibration     → stream_probe_tpu.json
+#   9/10. chip-gated compiled-kernel test           → pallas_tpu.log
+#  10/10. bench.py smoke (MFU + 4096-bucket leg)    → /tmp/bench_smoke.json
 #
 # Safe to re-run; each step is idempotent or overwrite-only.  Nothing here
 # git-commits — artifacts are reviewed and committed by hand.
@@ -63,7 +64,7 @@ EOF
 do
   log "waiting for the zero-drop corpus100 (stealth variants)"; sleep 60
 done
-log "1/9 joint-100h training"
+log "1/10 joint-100h training"
 # the corpus is ~10 GB and rotates shards through the chip each epoch; over
 # a ~0.5 GB/s tunnel the wall clock is transfer-bound, so budget generously
 # and rely on resume-from-checkpoint for the retry.  The tunnel has twice
@@ -87,7 +88,7 @@ if [ -f runs/joint-100h/metrics.json ]; then
   cp runs/joint-100h/metrics.json benchmarks/results/joint100h_r5.json
   log "copied joint100h artifact"
 fi
-log "2/9 joint-dense training (deployed 4096n/8192e bucket)"
+log "2/10 joint-dense training (deployed 4096n/8192e bucket)"
 for attempt in 1 2; do
   wait_for_tpu
   NERRF_REQUIRE_ACCEL=1 timeout 7200 python -m nerrf_tpu.train.run \
@@ -102,7 +103,7 @@ if [ -f runs/joint-dense/metrics.json ]; then
   cp runs/joint-dense/metrics.json benchmarks/results/joint_dense_r5.json
   log "copied joint-dense artifact"
 fi
-log "3/9 adversarial eval (flagship checkpoint when present)"
+log "3/10 adversarial eval (flagship checkpoint when present)"
 wait_for_tpu
 if [ -f runs/joint-100h/model/model_config.json ]; then
   timeout 3600 python benchmarks/run_adversarial_eval.py \
@@ -113,15 +114,20 @@ else
     --out benchmarks/results/adversarial_r5.json > /tmp/adv_r5.log 2>&1
 fi
 log "adversarial rc=$?"
-log "4/9 graph capacity (pallas crossover)"
+log "4/10 graph capacity (pallas crossover)"
 wait_for_tpu
 timeout 1800 python benchmarks/run_graph_capacity.py \
   --out benchmarks/results/graph_capacity.json > /tmp/graphcap.log 2>&1
 log "graphcap rc=$?"
-log "5/9 planner throughput probe"
+log "5/10 aggregation kernel microbench ({segment,dense_adj,fused} x bucket)"
+wait_for_tpu
+timeout 1800 python benchmarks/run_kernel_bench.py \
+  --out benchmarks/results/kernel_bench_tpu.json > /tmp/kernel_bench.log 2>&1
+log "kernel bench rc=$?"
+log "6/10 planner throughput probe"
 timeout 1200 python benchmarks/run_planner_probe.py > /tmp/mcts_tpu.log 2>&1
 log "mcts rc=$?"
-log "6/9 recovery benches (device planner in the KPI path)"
+log "7/10 recovery benches (device planner in the KPI path)"
 wait_for_tpu
 timeout 1800 python benchmarks/run_recovery_bench.py --scale m0 \
   --out benchmarks/results/m0_recovery.json > /tmp/recovery_m0.log 2>&1
@@ -129,17 +135,17 @@ log "m0 recovery rc=$?"
 timeout 1800 python benchmarks/run_recovery_bench.py --scale m1 \
   --out benchmarks/results/m1_recovery.json > /tmp/recovery_m1.log 2>&1
 log "m1 recovery rc=$?"
-log "7/9 stream detector quality + calibration on chip"
+log "8/10 stream detector quality + calibration on chip"
 wait_for_tpu
 timeout 2400 python benchmarks/run_stream_eval.py --steps 1500 \
   --out benchmarks/results/stream_probe_tpu.json > /tmp/stream_tpu.log 2>&1
 log "stream quality rc=$?"
-log "8/9 chip-gated compiled-kernel test"
+log "9/10 chip-gated compiled-kernel test"
 wait_for_tpu
 NERRF_TEST_REAL_BACKEND=1 timeout 1200 python -m pytest \
   tests/test_pallas_ops.py -q -k compiled_on_tpu > /tmp/pallas_tpu.log 2>&1
 log "pallas chip test rc=$?"
-log "9/9 bench.py smoke (validates the driver's benchmark of record: MFU + 4096-bucket leg)"
+log "10/10 bench.py smoke (validates the driver's benchmark of record: MFU + 4096-bucket leg)"
 wait_for_tpu
 timeout 3600 python bench.py > /tmp/bench_smoke.json 2> /tmp/bench_smoke.log
 log "bench rc=$?"

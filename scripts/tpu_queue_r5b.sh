@@ -1,6 +1,7 @@
 #!/bin/bash
-# Round-5 chip-queue CONTINUATION (steps 7-9 of scripts/tpu_queue.sh,
-# reordered).  Steps 1-6 landed before the tunnel wedged at 18:22; the
+# Round-5 chip-queue CONTINUATION (4 steps: the original queue's steps
+# 7-9 reordered, plus the step-6 m1-recovery rerun).  Steps 1-6 landed
+# before the tunnel wedged at 18:22; the
 # remaining chip work is re-ordered so the round's #1 deliverable — the
 # clean bench.py line of record (MFU + 4096 leg) — runs FIRST in the next
 # tunnel window instead of behind a ~40 min stream-eval.  Same probe gate

@@ -162,9 +162,11 @@ def test_nerrfnet_jit_recompile_free():
 
 
 def test_gnn_aggregation_paths_parity():
-    """dense_adj (one [N,N] matmul per layer) and segment (gather +
-    banded segment-mean) must compute the same aggregation — the bench
-    times the dense path, training checkpoints must load into either."""
+    """All three aggregation shapes — dense_adj (one [N,N] matmul per
+    layer), fused (one sage_aggregate kernel per layer) and segment
+    (gather + banded segment-mean) — must compute the same aggregation on
+    the same param tree: the bench times dense/fused, training checkpoints
+    must load into any of them."""
     import dataclasses
 
     import jax
@@ -175,20 +177,56 @@ def test_gnn_aggregation_paths_parity():
     gin = ("node_feat", "node_type", "node_aux", "node_mask", "edge_src",
            "edge_dst", "edge_feat", "edge_mask")
     args = tuple(np.asarray(ds.arrays[k][1]) for k in gin)
-    cfg_d = GraphSAGEConfig(hidden=32, num_layers=4, dropout=0.0,
-                            aggregation="dense_adj")
-    cfg_s = dataclasses.replace(cfg_d, aggregation="segment")
-    gd, gs = GraphSAGET(cfg_d), GraphSAGET(cfg_s)
-    p = gd.init(jax.random.PRNGKey(0), *args)["params"]
-    ps = gs.init(jax.random.PRNGKey(0), *args)["params"]
-    assert (jax.tree_util.tree_structure(p)
-            == jax.tree_util.tree_structure(ps))
-    od = gd.apply({"params": p}, *args)
+    cfg_s = GraphSAGEConfig(hidden=32, num_layers=4, dropout=0.0,
+                            aggregation="segment")
+    gs = GraphSAGET(cfg_s)
+    p = gs.init(jax.random.PRNGKey(0), *args)["params"]
     os_ = gs.apply({"params": p}, *args)
-    for k in ("edge_logit", "node_logit"):
-        err = np.max(np.abs(np.asarray(od[k], np.float32)
-                            - np.asarray(os_[k], np.float32)))
-        assert err < 0.15, (k, err)  # bf16 reorder noise over 4 layers
+    for mode in ("dense_adj", "fused"):
+        gm = GraphSAGET(dataclasses.replace(cfg_s, aggregation=mode))
+        pm = gm.init(jax.random.PRNGKey(0), *args)["params"]
+        assert (jax.tree_util.tree_structure(p)
+                == jax.tree_util.tree_structure(pm)), mode
+        om = gm.apply({"params": p}, *args)
+        for k in ("edge_logit", "node_logit"):
+            err = np.max(np.abs(np.asarray(om[k], np.float32)
+                                - np.asarray(os_[k], np.float32)))
+            assert err < 0.15, (mode, k, err)  # bf16 reorder noise, 4 layers
+
+
+def test_gnn_fused_mode_gradient_parity():
+    """The fused path must TRAIN identically, not just infer: parameter
+    gradients through the fused-mode wiring (pre-normalized views + the
+    XLA composition this CPU suite dispatches to) must match the segment
+    oracle in f32.  The fused KERNEL's custom VJP is covered separately:
+    tests/test_ops_fused.py runs model-level gradients with the
+    interpret-mode Pallas kernel registered."""
+    import dataclasses
+
+    import jax
+
+    from nerrf_tpu.models.graphsage import GraphSAGEConfig, GraphSAGET
+
+    ds = _dataset()
+    gin = ("node_feat", "node_type", "node_aux", "node_mask", "edge_src",
+           "edge_dst", "edge_feat", "edge_mask")
+    args = tuple(np.asarray(ds.arrays[k][1]) for k in gin)
+    cfg = GraphSAGEConfig(hidden=16, num_layers=2, dropout=0.0,
+                          dtype=jnp.float32, aggregation="segment")
+    m_s = GraphSAGET(cfg)
+    m_f = GraphSAGET(dataclasses.replace(cfg, aggregation="fused"))
+    p = m_s.init(jax.random.PRNGKey(1), *args)["params"]
+
+    def loss(model):
+        return lambda pp: jnp.sum(
+            model.apply({"params": pp}, *args)["node_logit"] ** 2)
+
+    gseg = jax.grad(loss(m_s))(p)
+    gfus = jax.grad(loss(m_f))(p)
+    errs = jax.tree_util.tree_map(
+        lambda a, b: float(jnp.max(jnp.abs(a - b))), gseg, gfus)
+    worst = max(jax.tree_util.tree_leaves(errs))
+    assert worst < 1e-3, errs
 
 
 def test_lstm_impl_paths_parity():

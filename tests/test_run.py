@@ -7,6 +7,34 @@ import pytest
 from nerrf_tpu.train.run import run_experiment
 
 
+def test_forced_platform_fails_fast_on_dead_probe(monkeypatch, tmp_path):
+    """Operator forced `--platform tpu` but the reachability probe fails:
+    the run must die immediately with the probe detail instead of silently
+    pinning a flagship training run to CPU and burning the 7200 s queue
+    slot (r4 advisor; mirrors run_recovery_bench's 'explicit choice keeps
+    the hard failure' rule)."""
+    import jax
+
+    import nerrf_tpu.train.run as run_mod
+    import nerrf_tpu.utils as utils
+
+    monkeypatch.delenv("NERRF_COORDINATOR", raising=False)
+    monkeypatch.setattr(utils, "ensure_backend_or_cpu",
+                        lambda *a, **k: (False, "probe timed out (test)"))
+    called = []
+    monkeypatch.setattr(run_mod, "run_experiment",
+                        lambda *a, **k: called.append(1))
+    try:
+        with pytest.raises(SystemExit, match="refusing to degrade"):
+            run_mod.main(["--experiment", "toy-graphsage",
+                          "--out", str(tmp_path), "--platform", "tpu"])
+    finally:
+        # main() pinned jax_platforms to 'tpu' before probing; restore the
+        # suite's CPU pin (the already-initialized backend is unaffected)
+        jax.config.update("jax_platforms", "cpu")
+    assert not called, "training must not start after a failed forced probe"
+
+
 @pytest.mark.slow
 def test_run_toy_experiment_produces_artifacts(tmp_path):
     report = run_experiment("toy-graphsage", tmp_path, num_steps=60)

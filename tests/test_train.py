@@ -197,6 +197,68 @@ def test_checkpoint_feature_layout_gate(tmp_path):
         load_checkpoint(tmp_path / "m")
 
 
+def test_checkpoint_schema_version_gate(tmp_path):
+    """The sidecar carries an explicit schema version: current checkpoints
+    stamp it and round-trip; a sidecar from NEWER code fails fast instead of
+    loading fields it cannot interpret; aggregation='fused' (same param
+    tree as segment/dense_adj) round-trips through the config sidecar."""
+    import dataclasses
+    import json
+
+    import numpy as np
+    import pytest
+
+    from nerrf_tpu.models import GraphSAGEConfig, LSTMConfig
+    from nerrf_tpu.models import JointConfig as JC
+    from nerrf_tpu.train.checkpoint import (
+        SCHEMA_VERSION,
+        load_checkpoint,
+        save_checkpoint,
+    )
+
+    cfg = JC(gnn=GraphSAGEConfig(hidden=8, num_layers=1,
+                                 aggregation="fused"),
+             lstm=LSTMConfig(hidden=8, num_layers=1))
+    params = {"w": np.ones((2, 2), np.float32)}
+    save_checkpoint(tmp_path / "m", params, cfg)
+    sidecar = tmp_path / "m" / "model_config.json"
+    meta = json.loads(sidecar.read_text())
+    assert meta["schema_version"] == SCHEMA_VERSION
+
+    _, cfg2 = load_checkpoint(tmp_path / "m")
+    assert cfg2.gnn.aggregation == "fused"
+
+    meta["schema_version"] = SCHEMA_VERSION + 1
+    sidecar.write_text(json.dumps(meta))
+    with pytest.raises(ValueError, match="newer version"):
+        load_checkpoint(tmp_path / "m")
+
+    from nerrf_tpu.train.checkpoint import MIN_SCHEMA_VERSION
+
+    meta["schema_version"] = MIN_SCHEMA_VERSION - 1
+    sidecar.write_text(json.dumps(meta))
+    with pytest.raises(ValueError, match="oldest supported"):
+        load_checkpoint(tmp_path / "m")
+
+
+def test_stream_checkpoint_threshold_space_stamped(tmp_path):
+    """The stream sidecar records which space the calibrated cut lives in
+    (raw logits — r4 advisor): stamped by default even when the caller's
+    calibration dict omits it, and a caller-provided value wins."""
+    import json
+
+    import numpy as np
+
+    from nerrf_tpu.models import StreamConfig
+    from nerrf_tpu.train.checkpoint import save_stream_checkpoint
+
+    params = {"w": np.ones((2, 2), np.float32)}
+    save_stream_checkpoint(tmp_path / "s", params, StreamConfig(),
+                           calibration={"stream_event_threshold": 1.25})
+    meta = json.loads((tmp_path / "s" / "stream_config.json").read_text())
+    assert meta["calibration"]["stream_event_threshold_space"] == "logit"
+
+
 def test_evaluate_resident_matches_host_slicing(small_dataset):
     """Device-resident eval (one upload + index-driven batches) must produce
     identical metrics to the per-batch host-slicing path, including the
