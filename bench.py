@@ -204,11 +204,31 @@ def main() -> None:
     for _ in range(timed_calls):
         state, losses, rng = train_step(state, rng)
     loss = losses[-1]
+    # step-time attribution: everything up to here is host dispatch (the
+    # supersteps queue async), the final fetch is the host-blocked wait for
+    # the device to drain — their split says whether the chip or the host
+    # owns the step time (data-wait is structurally zero on this path: the
+    # dataset and batch schedule are device-resident)
+    dispatch_s = time.perf_counter() - t0
     fetch(loss)
     elapsed = time.perf_counter() - t0
     steps_per_sec = timed_steps / elapsed
+    host_blocked_fraction = max(elapsed - dispatch_s, 0.0) / elapsed
+    from nerrf_tpu.observability import DEFAULT_REGISTRY
+    from nerrf_tpu.train.data import padding_waste_fractions
+
+    padding_waste = padding_waste_fractions(train_ds.arrays)
+    DEFAULT_REGISTRY.gauge_set(
+        "train_host_blocked_fraction", host_blocked_fraction,
+        help="fraction of timed train wall spent blocked on device results")
+    for kind, frac in padding_waste.items():
+        DEFAULT_REGISTRY.gauge_set(
+            "train_padding_waste_fraction", frac,
+            labels={"kind": kind, "bucket": shape_tag},
+            help="fraction of padded capacity carrying no real data")
     log(f"[bench] {timed_steps} steps in {elapsed:.1f}s → {steps_per_sec:.2f} steps/s "
-        f"(final loss {float(loss):.4f})")
+        f"(final loss {float(loss):.4f}, host-blocked "
+        f"{100 * host_blocked_fraction:.0f}%, padding waste {padding_waste})")
 
     # --- MFU: analytic model FLOPs of one step × steps/s vs chip peak.
     # flops.py counts every dot_general/conv in the step's jaxpr at its
@@ -274,6 +294,7 @@ def main() -> None:
             big_tflops, big_mfu = mfu(big_flops, big_sps, jax.devices()[0])
             big_bucket = {
                 "shape": "4096n/8192e/128seq", "batch": big_cfg.batch_size,
+                "padding_waste": padding_waste_fractions(big_ds.arrays),
                 # the 4096 bucket routes `auto` differently from the
                 # flagship shape (fused past DENSE_ADJ_MAX_NODES) — stamp
                 # the mode this leg's numbers belong to
@@ -551,6 +572,16 @@ def main() -> None:
         "mfu_pct": round(mfu_pct, 2) if mfu_pct else None,
         "steps_per_call": steps_per_call,
         "tunnel_rtt_ms": tunnel_rtt_ms,
+        "attribution": {
+            # where the flagship step time went (see docs/benchmarks.md):
+            # host_blocked = waiting on device results, host_dispatch =
+            # issuing work; data_wait is structurally 0 on the
+            # device-resident schedule; padding waste per capacity bucket
+            "host_blocked_fraction": round(host_blocked_fraction, 4),
+            "host_dispatch_fraction": round(dispatch_s / elapsed, 4),
+            "data_wait_fraction": 0.0,
+            "padding_waste": {shape_tag: padding_waste},
+        },
         "sync_method": "device-to-host fetch of the final loss "
                        "(block_until_ready is a no-op on this platform)",
         "big_bucket": big_bucket,

@@ -26,6 +26,8 @@ def main() -> int:
     ap.add_argument("--platform", default=None)
     ap.add_argument("--k", type=int, default=64)
     ap.add_argument("--buckets", default="1024,4096")
+    ap.add_argument("--trace-out", default=None, metavar="FILE",
+                    help="write the run's host spans as Chrome-trace JSON")
     args = ap.parse_args()
 
     import jax
@@ -44,8 +46,9 @@ def main() -> int:
     from nerrf_tpu.models import JointConfig, NerrfNet
     from nerrf_tpu.models.graphsage import GraphSAGET
     from nerrf_tpu.models.lstm import ImpactLSTM
+    from nerrf_tpu.tracing import DEFAULT_TRACER
     from nerrf_tpu.train import TrainConfig, build_dataset
-    from nerrf_tpu.train.data import DatasetConfig
+    from nerrf_tpu.train.data import (DatasetConfig, padding_waste_fractions)
     from nerrf_tpu.train.loop import make_loss_fn, model_inputs
 
     log = lambda *a: print(*a, file=sys.stderr, flush=True)
@@ -101,11 +104,16 @@ def main() -> int:
             c, _ = jax.lax.scan(body, jnp.float32(0.0), None, length=k)
             return c
 
+        # spans around both legs so --trace-out shows the timeline behind
+        # every reported number (compile vs steady-state, per leg)
+        slug = tag.replace(" ", "_").replace("+", "")
         t0 = time.perf_counter()
-        fetch(run(*fargs))
+        with DEFAULT_TRACER.span(f"profile_compile_{slug}", k=k):
+            fetch(run(*fargs))
         compile_s = time.perf_counter() - t0
         t0 = time.perf_counter()
-        fetch(run(*fargs))
+        with DEFAULT_TRACER.span(f"profile_{slug}", device=True, k=k):
+            fetch(run(*fargs))
         per = max(time.perf_counter() - t0 - rtt, 1e-9) / k
         log(f"  {tag}: {per * 1e3:8.2f} ms/iter (compile {compile_s:.0f}s)")
         return per
@@ -113,7 +121,8 @@ def main() -> int:
     corpus = make_corpus(8, attack_fraction=0.5, base_seed=42,
                          duration_sec=180.0, num_target_files=24,
                          benign_rate_hz=40.0)
-    report = {"backend": jax.default_backend(), "k": args.k, "buckets": {}}
+    report = {"backend": jax.default_backend(), "k": args.k,
+              "per_call_overhead_ms": round(rtt * 1e3, 2), "buckets": {}}
     cfg = TrainConfig(model=JointConfig(), batch_size=8, num_steps=8, seed=0)
     model = NerrfNet(cfg.model)
     loss_fn = make_loss_fn(model, cfg)
@@ -179,10 +188,16 @@ def main() -> int:
 
         f = analytic_flops(grad_fn, params, batch)
         r["analytic_step_gflops"] = round(f / 1e9, 1) if f else None
-        report["buckets"][f"{mn}n/{me}e"] = {
-            k: (round(v, 2) if isinstance(v, float) else v)
-            for k, v in r.items()}
+        cell = {k: (round(v, 2) if isinstance(v, float) else v)
+                for k, v in r.items()}
+        # padded capacity IS compute cost at static shapes — the waste
+        # fraction travels with every per-bucket time it explains
+        cell["padding_waste"] = padding_waste_fractions(arrs)
+        report["buckets"][f"{mn}n/{me}e"] = cell
 
+    if args.trace_out:
+        path = DEFAULT_TRACER.write(args.trace_out)
+        log(f"[profile] host spans written to {path}")
     print(json.dumps(report, indent=2))
     return 0
 

@@ -289,6 +289,28 @@ def cmd_warmup(args) -> int:
 
 
 # --------------------------------------------------------------------------
+def cmd_trace(args) -> int:
+    """Offline inspector for ``--trace-out`` artifacts: per-stage latency
+    table (count, total/mean/p50/max ms, % of wall) from a Chrome-trace
+    JSON file.  The same file loads in Perfetto / chrome://tracing for the
+    timeline view; this is the terminal-sized summary."""
+    from nerrf_tpu import tracing
+
+    try:
+        events = tracing.load_chrome_trace(args.file)
+    except (OSError, ValueError) as e:
+        # ValueError covers both JSONDecodeError and UnicodeDecodeError
+        # (binary Perfetto traces are not the JSON flavor this reads)
+        _log(f"cannot read trace {args.file}: {e}")
+        return 2
+    if not events:
+        _log(f"no complete ('X') span events in {args.file}")
+        return 1
+    print(tracing.format_stage_table(events))
+    return 0
+
+
+# --------------------------------------------------------------------------
 def cmd_status(args) -> int:
     inc = Path(args.incident)
     stages = {
@@ -477,6 +499,9 @@ def main(argv=None) -> int:
     p.add_argument("--ckpt-every", type=int, default=0,
                    help="checkpoint the full train state every N steps and "
                         "resume from the latest on restart (0 = off)")
+    p.add_argument("--trace-out", default=None, metavar="FILE",
+                   help="write a Chrome-trace JSON of the run's host spans "
+                        "(enables per-step synced attribution spans)")
     p.set_defaults(fn=cmd_train_detector)
 
     p = sub.add_parser("undo", help="detect, plan, rehearse and roll back")
@@ -500,6 +525,9 @@ def main(argv=None) -> int:
                    help="skip the bounded accelerator-reachability probe "
                         "(a resident daemon with a warm backend wants this; "
                         "one-shot undo on a possibly-wedged host does not)")
+    p.add_argument("--trace-out", default=None, metavar="FILE",
+                   help="write a Chrome-trace JSON of the incident's "
+                        "detect/plan/gate/execute spans")
     p.set_defaults(fn=cmd_undo)
 
     p = sub.add_parser("status", help="incident state")
@@ -527,7 +555,17 @@ def main(argv=None) -> int:
     p.add_argument("--batch-size", type=int, default=64)
     p.add_argument("--duration", type=float, default=0,
                    help="serve for N seconds then exit (0 = until signal)")
+    p.add_argument("--trace-out", default=None, metavar="FILE",
+                   help="write a Chrome-trace JSON of the serve session's "
+                        "host spans on exit")
     p.set_defaults(fn=cmd_serve)
+
+    p = sub.add_parser("trace", help="per-stage latency table from a "
+                                     "--trace-out Chrome-trace file")
+    p.add_argument("--file", required=True,
+                   help="Chrome-trace JSON produced by --trace-out (or any "
+                        "trace-event file)")
+    p.set_defaults(fn=cmd_trace)
 
     p = sub.add_parser("doctor", help="diagnose the environment (deps, "
                                       "backend, toolchain, capture, sandbox)")
@@ -555,7 +593,34 @@ def main(argv=None) -> int:
     p.set_defaults(fn=cmd_ingest)
 
     args = ap.parse_args(argv)
-    return args.fn(args)
+    trace_out = getattr(args, "trace_out", None)
+    if trace_out:
+        # enable BEFORE the command body: hot loops opt into per-step
+        # synced attribution spans only when the tracer is enabled.  Clear
+        # first so the file holds THIS command's spans (embedded callers
+        # may run several commands in one process), and restore the
+        # previous enabled state after — --trace-out on one command must
+        # not leave later commands paying the per-step sync.
+        from nerrf_tpu import tracing
+
+        prev_enabled = tracing.DEFAULT_TRACER.enabled
+        tracing.DEFAULT_TRACER.clear()
+        tracing.set_enabled(True)
+    try:
+        return args.fn(args)
+    finally:
+        if trace_out:
+            tracing.set_enabled(prev_enabled)
+            try:
+                path = tracing.DEFAULT_TRACER.write(trace_out)
+            except OSError as e:
+                # must not mask the command's own outcome/exception with a
+                # write failure at the very end of a long run
+                _log(f"could not write trace to {trace_out}: {e}")
+            else:
+                _log(f"{len(tracing.DEFAULT_TRACER.records())} spans "
+                     f"written to {path} — inspect with `nerrf trace "
+                     f"--file {path}` or load in Perfetto/chrome://tracing")
 
 
 if __name__ == "__main__":

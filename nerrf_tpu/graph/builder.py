@@ -34,6 +34,7 @@ from typing import Iterator, List, Optional, Tuple
 import numpy as np
 
 from nerrf_tpu.data.loaders import Trace
+from nerrf_tpu.tracing import span as trace_span
 from nerrf_tpu.schema.events import (
     EXT_VOCAB,
     EventArrays,
@@ -250,6 +251,22 @@ def build_window_graph(
     labels: Optional[np.ndarray] = None,
 ) -> Tuple[GraphBatch, WindowStats]:
     """Lower the events in [lo_ns, hi_ns) to one padded window graph."""
+    with trace_span("graph_lower") as sp:
+        g, stats = _build_window_graph(events, strings, lo_ns, hi_ns, cfg,
+                                       labels=labels)
+        sp.args.update(events=stats.num_events, nodes=stats.num_nodes,
+                       edges=stats.num_edges)
+    return g, stats
+
+
+def _build_window_graph(
+    events: EventArrays,
+    strings: StringTable,
+    lo_ns: int,
+    hi_ns: int,
+    cfg: GraphConfig,
+    labels: Optional[np.ndarray] = None,
+) -> Tuple[GraphBatch, WindowStats]:
     stats = WindowStats()
     window_ns = max(hi_ns - lo_ns, 1)
 

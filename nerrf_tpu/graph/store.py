@@ -29,6 +29,7 @@ import numpy as np
 
 from nerrf_tpu.ingest.bridge import _Columns, _alloc_columns, load_native_lib
 from nerrf_tpu.schema.events import EventArrays, StringTable
+from nerrf_tpu.tracing import span as trace_span
 
 _LIB_NAME = "libnerrf_tracestore.so"
 
@@ -194,13 +195,15 @@ class TraceStore:
         return self._py.append(events, strings)
 
     def flush(self) -> int:
-        if self._native:
-            got = _LIB.nerrf_store_flush(self._handle)
-            if got < 0:
-                raise OSError("nerrf_store_flush failed")
-            got = int(got)
-        else:
-            got = self._py.flush()
+        with trace_span("store_compact") as sp:
+            if self._native:
+                got = _LIB.nerrf_store_flush(self._handle)
+                if got < 0:
+                    raise OSError("nerrf_store_flush failed")
+                got = int(got)
+            else:
+                got = self._py.flush()
+            sp.args["segments"] = got
         from nerrf_tpu.observability import DEFAULT_REGISTRY
 
         DEFAULT_REGISTRY.counter_inc(
@@ -221,6 +224,10 @@ class TraceStore:
     def query(self, start_ns: int, end_ns: int) -> Tuple[EventArrays, StringTable]:
         """Events in [start_ns, end_ns) sorted by time, with a StringTable
         whose ids match the returned columns (identity view of the pool)."""
+        with trace_span("store_query"):
+            return self._query(start_ns, end_ns)
+
+    def _query(self, start_ns: int, end_ns: int) -> Tuple[EventArrays, StringTable]:
         if self._native:
             # start with a window-sized guess; on -(needed)-1 retry with the
             # exact size.  Bounded by total rows so allocation never exceeds

@@ -22,7 +22,6 @@ from __future__ import annotations
 
 import queue
 import threading
-import time
 from typing import Iterator, Optional
 
 import grpc
@@ -31,6 +30,7 @@ import numpy as np
 from nerrf_tpu.ingest import trace_pb2
 from nerrf_tpu.ingest.bridge import IngestBridge, events_to_batch_frames
 from nerrf_tpu.schema import EventArrays, StringTable
+from nerrf_tpu.tracing import span as trace_span
 
 SERVICE_NAME = "nerrf.trace.Tracker"
 STREAM_METHOD = "StreamEvents"
@@ -75,10 +75,18 @@ class TraceReplayServer:
 
         DEFAULT_REGISTRY.counter_inc(
             "tracker_subscribers_total", help="StreamEvents subscriptions served")
-        for frame in self._frames:
-            DEFAULT_REGISTRY.counter_inc(
-                "tracker_frames_sent_total", help="EventBatch frames streamed")
-            yield frame
+        # one span per subscription: its duration is the full stream drain
+        # (gRPC flow control paces it), so a slow consumer is visible as a
+        # long tracker_stream span in the serve-side trace
+        with trace_span("tracker_stream") as sp:
+            sent = 0
+            for frame in self._frames:
+                DEFAULT_REGISTRY.counter_inc(
+                    "tracker_frames_sent_total",
+                    help="EventBatch frames streamed")
+                yield frame
+                sent += 1
+            sp.args["frames"] = sent
 
     def subscriber_queue(self) -> "queue.Queue[Optional[bytes]]":
         """Bounded frame queue with the live-source overflow policy: callers
@@ -136,11 +144,12 @@ class TrackerClient:
             from nerrf_tpu.observability import DEFAULT_REGISTRY
 
             for frame in call:
-                t0 = time.perf_counter()
-                block = self._bridge.decode_batch(frame)
-                DEFAULT_REGISTRY.histogram_observe(
-                    "ingest_decode_seconds", time.perf_counter() - t0,
-                    help="EventBatch frame decode latency")
+                # one instrumentation point: the span dual-writes the
+                # stage_latency_seconds{stage="ingest_decode"} histogram,
+                # so the Prometheus series and the trace stay consistent
+                with trace_span("ingest_decode") as sp:
+                    block = self._bridge.decode_batch(frame)
+                    sp.args["events"] = int(block.num_valid)
                 DEFAULT_REGISTRY.counter_inc(
                     "ingest_events_total", block.num_valid,
                     help="events decoded from the tracker stream")

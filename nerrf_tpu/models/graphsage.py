@@ -288,25 +288,34 @@ class GraphSAGET(nn.Module):
         else:
             raise ValueError(f"unknown aggregation mode {agg_mode!r}")
 
+        # named scopes mirror the host tracing spine: XLA trace rows for
+        # each layer show up as gnn_layer_<i> in Perfetto, next to the
+        # device_step host span that dispatched them
         for i in range(cfg.num_layers):
-            h = SageBlock(cfg.hidden, dtype=dt, name=f"block_{i}")(
-                h, e_emb, edge_src, edge_dst, edge_w, n,
-                rev_view=rev_view, dense_view=dense_view,
-                fused_view=fused_view
-            )
-            h = h * node_mask[:, None].astype(dt)
+            with jax.named_scope(f"gnn_layer_{i}"):
+                h = SageBlock(cfg.hidden, dtype=dt, name=f"block_{i}")(
+                    h, e_emb, edge_src, edge_dst, edge_w, n,
+                    rev_view=rev_view, dense_view=dense_view,
+                    fused_view=fused_view
+                )
+                h = h * node_mask[:, None].astype(dt)
 
-        h = nn.LayerNorm(dtype=dt, name="final_ln")(h)
-        if cfg.dropout > 0:
-            h = nn.Dropout(cfg.dropout, deterministic=deterministic)(h)
+        with jax.named_scope("gnn_heads"):
+            h = nn.LayerNorm(dtype=dt, name="final_ln")(h)
+            if cfg.dropout > 0:
+                h = nn.Dropout(cfg.dropout, deterministic=deterministic)(h)
 
-        node_logit = nn.Dense(1, dtype=jnp.float32, name="node_head")(h)[:, 0]
+            node_logit = nn.Dense(
+                1, dtype=jnp.float32, name="node_head")(h)[:, 0]
 
-        h_src = gather_rows(h, edge_src)
-        h_dst = gather_rows(h, edge_dst)
-        pair = jnp.concatenate([h_src, h_dst, h_src * h_dst, e_emb], axis=-1)
-        z = nn.gelu(nn.Dense(cfg.hidden, dtype=dt, name="edge_head_1")(pair))
-        edge_logit = nn.Dense(1, dtype=jnp.float32, name="edge_head_2")(z)[:, 0]
+            h_src = gather_rows(h, edge_src)
+            h_dst = gather_rows(h, edge_dst)
+            pair = jnp.concatenate(
+                [h_src, h_dst, h_src * h_dst, e_emb], axis=-1)
+            z = nn.gelu(
+                nn.Dense(cfg.hidden, dtype=dt, name="edge_head_1")(pair))
+            edge_logit = nn.Dense(
+                1, dtype=jnp.float32, name="edge_head_2")(z)[:, 0]
 
         return {
             "edge_logit": jnp.where(edge_mask, edge_logit, -30.0),

@@ -154,7 +154,11 @@ class ImpactLSTM(nn.Module):
             h = nn.sigmoid(go) * jnp.tanh(c)
             return (h, c), h
 
-        (_, _), hs = jax.lax.scan(step, (h0, c0), xs)       # [T,2,B,H]
+        # named scope mirrors the host tracing spine: the recurrence's XLA
+        # trace rows appear as lstm_scan in Perfetto next to the
+        # device_step host span
+        with jax.named_scope("lstm_scan"):
+            (_, _), hs = jax.lax.scan(step, (h0, c0), xs)   # [T,2,B,H]
         hs = jnp.moveaxis(hs, 0, -2)                        # [2,B,T,H]
         fwd = hs[0]
         bwd = _flip_valid(hs[1], lengths)  # back to original time order
@@ -181,18 +185,19 @@ class ImpactLSTM(nn.Module):
         mask_pf = jnp.flip(seq_mask, axis=-1)[..., None].astype(dt)
         impl = cfg.resolved_impl()
         for i in range(cfg.num_layers):
-            if impl == "fused":
-                fwd, bwd = self._fused_bilayer(x, lengths, i)
-            else:
-                fwd = nn.RNN(nn.OptimizedLSTMCell(cfg.hidden, dtype=dt),
-                             name=f"fwd_{i}")(x, seq_lengths=lengths)
-                bwd = nn.RNN(nn.OptimizedLSTMCell(cfg.hidden, dtype=dt),
-                             reverse=True, keep_order=True,
-                             name=f"bwd_{i}")(x, seq_lengths=lengths)
-            y = jnp.concatenate([fwd, bwd], axis=-1)
-            x = nn.Dense(cfg.hidden, dtype=dt, name=f"merge_{i}")(y)
-            x = nn.gelu(x)
-            x = x * mask_pf
+            with jax.named_scope(f"lstm_layer_{i}"):
+                if impl == "fused":
+                    fwd, bwd = self._fused_bilayer(x, lengths, i)
+                else:
+                    fwd = nn.RNN(nn.OptimizedLSTMCell(cfg.hidden, dtype=dt),
+                                 name=f"fwd_{i}")(x, seq_lengths=lengths)
+                    bwd = nn.RNN(nn.OptimizedLSTMCell(cfg.hidden, dtype=dt),
+                                 reverse=True, keep_order=True,
+                                 name=f"bwd_{i}")(x, seq_lengths=lengths)
+                y = jnp.concatenate([fwd, bwd], axis=-1)
+                x = nn.Dense(cfg.hidden, dtype=dt, name=f"merge_{i}")(y)
+                x = nn.gelu(x)
+                x = x * mask_pf
 
         # mask-aware mean pool over valid steps
         pooled = (x * mask_pf).sum(axis=-2) / jnp.maximum(

@@ -25,6 +25,7 @@ import contextlib
 import http.server
 import threading
 import time
+import warnings
 from typing import Dict, Iterable, Optional, Tuple
 
 _LabelKey = Tuple[Tuple[str, str], ...]
@@ -34,10 +35,23 @@ def _labelkey(labels: Optional[Dict[str, str]]) -> _LabelKey:
     return tuple(sorted((labels or {}).items()))
 
 
+def _escape_label_value(v: str) -> str:
+    """Label-value escaping per the text exposition format: backslash,
+    double-quote and newline must be escaped or one label value corrupts
+    every series after it in the scrape."""
+    return (str(v).replace("\\", r"\\").replace('"', r"\"")
+            .replace("\n", r"\n"))
+
+
+def _escape_help(text: str) -> str:
+    """HELP text escaping (backslash and newline per the format spec)."""
+    return str(text).replace("\\", r"\\").replace("\n", r"\n")
+
+
 def _fmt_labels(key: _LabelKey) -> str:
     if not key:
         return ""
-    inner = ",".join(f'{k}="{v}"' for k, v in key)
+    inner = ",".join(f'{k}="{_escape_label_value(v)}"' for k, v in key)
     return "{" + inner + "}"
 
 
@@ -74,13 +88,30 @@ class MetricsRegistry:
             if help:
                 self._help.setdefault(name, help)
 
+    DEFAULT_BUCKETS = (0.001, 0.005, 0.025, 0.1, 0.5, 2.5, 10.0)
+
     def histogram_observe(self, name: str, value: float,
-                          buckets: Iterable[float] = (
-                              0.001, 0.005, 0.025, 0.1, 0.5, 2.5, 10.0),
+                          buckets: Optional[Iterable[float]] = None,
                           labels: Optional[Dict[str, str]] = None,
                           help: str = "") -> None:
+        """Observe into a fixed-bucket histogram.
+
+        The bucket ladder is fixed at the metric's FIRST observation
+        (``buckets=None`` means "whatever is registered", falling back to
+        ``DEFAULT_BUCKETS``); a later call passing a *different* ladder
+        warns and keeps the registered one — re-bucketing mid-flight would
+        corrupt the cumulative counts already recorded."""
         with self._lock:
-            bk = self._hist_buckets.setdefault(name, tuple(buckets))
+            bk = self._hist_buckets.get(name)
+            if bk is None:
+                bk = tuple(buckets) if buckets is not None \
+                    else self.DEFAULT_BUCKETS
+                self._hist_buckets[name] = bk
+            elif buckets is not None and tuple(buckets) != bk:
+                warnings.warn(
+                    f"histogram {name!r} already registered with buckets "
+                    f"{bk}; ignoring differing buckets {tuple(buckets)}",
+                    stacklevel=2)
             d = self._hists.setdefault(name, {})
             k = _labelkey(labels)
             if k not in d:
@@ -95,11 +126,28 @@ class MetricsRegistry:
             if help:
                 self._help.setdefault(name, help)
 
-    def value(self, name: str, labels: Optional[Dict[str, str]] = None) -> float:
+    def value(self, name: str, labels: Optional[Dict[str, str]] = None,
+              stat: Optional[str] = None) -> float:
+        """Read back one series.  Counters/gauges return their value;
+        histograms return ``stat`` ∈ {"sum" (default), "count", "mean"}
+        instead of silently reading 0.0 for a registered metric."""
         with self._lock:
             for table in (self._counters, self._gauges):
                 if name in table:
                     return table[name].get(_labelkey(labels), 0.0)
+            if name in self._hists:
+                cell = self._hists[name].get(_labelkey(labels))
+                if cell is None:
+                    return 0.0
+                if stat in (None, "sum"):
+                    return float(cell[-2])
+                if stat == "count":
+                    return float(cell[-1])
+                if stat == "mean":
+                    return float(cell[-2]) / cell[-1] if cell[-1] else 0.0
+                raise ValueError(
+                    f"unknown histogram stat {stat!r}; "
+                    "expected 'sum', 'count' or 'mean'")
         return 0.0
 
     def render(self) -> str:
@@ -109,14 +157,14 @@ class MetricsRegistry:
             for name, series in sorted(self._counters.items()):
                 full = self._name(name)
                 if name in self._help:
-                    out.append(f"# HELP {full} {self._help[name]}")
+                    out.append(f"# HELP {full} {_escape_help(self._help[name])}")
                 out.append(f"# TYPE {full} counter")
                 for k, v in sorted(series.items()):
                     out.append(f"{full}{_fmt_labels(k)} {v:g}")
             for name, series in sorted(self._gauges.items()):
                 full = self._name(name)
                 if name in self._help:
-                    out.append(f"# HELP {full} {self._help[name]}")
+                    out.append(f"# HELP {full} {_escape_help(self._help[name])}")
                 out.append(f"# TYPE {full} gauge")
                 for k, v in sorted(series.items()):
                     out.append(f"{full}{_fmt_labels(k)} {v:g}")
@@ -124,7 +172,7 @@ class MetricsRegistry:
                 full = self._name(name)
                 bk = self._hist_buckets[name]
                 if name in self._help:
-                    out.append(f"# HELP {full} {self._help[name]}")
+                    out.append(f"# HELP {full} {_escape_help(self._help[name])}")
                 out.append(f"# TYPE {full} histogram")
                 for k, cell in sorted(series.items()):
                     for i, b in enumerate(bk):

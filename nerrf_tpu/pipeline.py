@@ -29,6 +29,7 @@ from nerrf_tpu.schema.events import (
     Syscall,
     is_suspicious_extension,
 )
+from nerrf_tpu.tracing import span as trace_span
 from nerrf_tpu.train.data import DatasetConfig, windows_of_trace
 from nerrf_tpu.train.loop import make_eval_fn
 
@@ -283,7 +284,15 @@ def model_detect(
     # detection must not peek at labels: strip them
     unlabelled = Trace(events=trace.events, strings=trace.strings,
                        ground_truth=None, labels=None, name=trace.name)
-    samples = windows_of_trace(unlabelled, ds_cfg)
+    # bucket_pad: trace → capacity-bucketed padded window samples (the
+    # graph_lower spans nest inside); the padded capacities stamped here
+    # are what the padding-waste gauges measure against
+    with trace_span("bucket_pad") as sp:
+        samples = windows_of_trace(unlabelled, ds_cfg)
+        sp.args.update(windows=len(samples),
+                       max_nodes=ds_cfg.graph.max_nodes,
+                       max_edges=ds_cfg.graph.max_edges,
+                       max_seqs=ds_cfg.max_seqs)
     ino_path = _inode_to_path(trace)
     pid_comm = _pid_to_comm(trace)
     eval_fn = make_eval_fn(model)
@@ -301,7 +310,8 @@ def model_detect(
                              chunk[0][k].dtype)] if pad else [])))
             for k in chunk[0]
         }
-        out = jax.device_get(eval_fn(params, batch))
+        with trace_span("detect_score", device=True, windows=len(chunk)):
+            out = jax.device_get(eval_fn(params, batch))
         probs = 1.0 / (1.0 + np.exp(-out["node_logit"]))
         for j, s in enumerate(chunk):
             mask = s["node_mask"]
@@ -503,11 +513,12 @@ def calibrate_file_thresholds(
     # operating point the OOD eval then measures at
     cfgs = [c for c in cfgs if c.scenario not in exclude_scenarios]
     incidents = []  # (DetectionResult, attack-touched set) per trace
-    for i, cfg in enumerate(cfgs):
-        tr = simulate_trace(cfg, name=f"calib-{i}-{cfg.scenario}")
-        det = model_detect(tr, params, model)
-        _, touched = attack_touched_files(tr)
-        incidents.append((det, touched))
+    with trace_span("calibrate", incidents=len(cfgs)):
+        for i, cfg in enumerate(cfgs):
+            tr = simulate_trace(cfg, name=f"calib-{i}-{cfg.scenario}")
+            det = model_detect(tr, params, model)
+            _, touched = attack_touched_files(tr)
+            incidents.append((det, touched))
     out: Dict[str, Calibration] = {}
     for agg in aggs:
         scores, labels = [], []

@@ -19,6 +19,7 @@ import numpy as np
 
 from nerrf_tpu.planner.domain import UndoDomain, UndoPlan
 from nerrf_tpu.planner.value_net import HeuristicValue, ValueFn
+from nerrf_tpu.tracing import span as trace_span
 
 
 @dataclasses.dataclass(frozen=True)
@@ -125,6 +126,13 @@ class MCTSPlanner:
 
     # --- main loop -----------------------------------------------------------
     def plan(self) -> UndoPlan:
+        with trace_span("mcts_plan",
+                        simulations=self.cfg.num_simulations) as sp:
+            plan = self._plan()
+            sp.args["rollouts"] = plan.rollouts
+        return plan
+
+    def _plan(self) -> UndoPlan:
         t0 = time.perf_counter()
         cfg = self.cfg
         self._reset()  # planner is reusable: every plan() searches a fresh tree
@@ -160,7 +168,11 @@ class MCTSPlanner:
         def resolve(batch: tuple[list, object]) -> None:
             nonlocal sims
             frontier, fut = batch
-            values = np.asarray(fut)  # sync point (device round trip)
+            # the sync point (device round trip): when these spans dominate
+            # mcts_plan, the search is device-bound, not tree-bound
+            with trace_span("mcts_leaf_eval", device=True,
+                            batch=len(frontier)):
+                values = np.asarray(fut)
             terminal = np.array(
                 [self.is_terminal[leaf] for leaf, _ in frontier])
             values = np.where(terminal, 0.0, values)

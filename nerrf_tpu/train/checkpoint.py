@@ -17,6 +17,7 @@ import jax
 import orbax.checkpoint as ocp
 
 from nerrf_tpu.models import GraphSAGEConfig, JointConfig, LSTMConfig
+from nerrf_tpu.tracing import span as trace_span
 
 
 # Sidecar schema version, stamped into every checkpoint and validated at
@@ -83,8 +84,9 @@ def save_checkpoint(path: str | Path, params, cfg: JointConfig,
                     calibration: dict | None = None) -> None:
     path = Path(path).absolute()
     path.mkdir(parents=True, exist_ok=True)
-    with ocp.StandardCheckpointer() as ckptr:
-        ckptr.save(path / "params", jax.device_get(params), force=True)
+    with trace_span("checkpoint", kind="params"):
+        with ocp.StandardCheckpointer() as ckptr:
+            ckptr.save(path / "params", jax.device_get(params), force=True)
     meta = {
         "gnn": {"hidden": cfg.gnn.hidden, "num_layers": cfg.gnn.num_layers,
                 "dropout": cfg.gnn.dropout,

@@ -121,6 +121,19 @@ def windows_of_trace(trace: Trace, cfg: DatasetConfig,
     return out
 
 
+def padding_waste_fractions(arrays) -> dict[str, float]:
+    """Fraction of padded capacity carrying no real data, per dimension.
+
+    Static shapes mean a padded slot costs exactly as much device compute
+    as a real one, so this IS the step-time attribution for bucket sizing:
+    train loops stamp it as the ``train_padding_waste_fraction`` gauge and
+    the bench artifacts carry it per bucket."""
+    masks = (("node", "node_mask"), ("edge", "edge_mask"),
+             ("seq", "seq_valid"))
+    return {kind: round(float(1.0 - np.asarray(arrays[key]).mean()), 4)
+            for kind, key in masks if key in arrays}
+
+
 def fit_dataset_config(traces: List[Trace],
                        cfg: Optional[DatasetConfig] = None) -> DatasetConfig:
     """A DatasetConfig whose graph capacities fit every window of ``traces``

@@ -42,6 +42,7 @@ from nerrf_tpu.utils import sync_result
 import orbax.checkpoint as ocp
 
 from nerrf_tpu.models.joint import NerrfNet
+from nerrf_tpu.tracing import DEFAULT_TRACER
 from nerrf_tpu.train.data import WindowDataset
 from nerrf_tpu.train.loop import (
     TrainConfig,
@@ -85,12 +86,13 @@ def fault_at(step: int) -> _FaultAt:
 
 def _save_full(ckpt_dir: Path, step: int, state) -> None:
     out = ckpt_dir / f"step_{step:08d}"
-    with ocp.StandardCheckpointer() as ckptr:
-        ckptr.save(out.absolute() / "state",
-                   jax.device_get({"params": state.params,
-                                   "opt_state": state.opt_state}),
-                   force=True)
-    (out / "meta.json").write_text(json.dumps({"step": step}) + "\n")
+    with DEFAULT_TRACER.span("checkpoint", step=step):
+        with ocp.StandardCheckpointer() as ckptr:
+            ckptr.save(out.absolute() / "state",
+                       jax.device_get({"params": state.params,
+                                       "opt_state": state.opt_state}),
+                       force=True)
+        (out / "meta.json").write_text(json.dumps({"step": step}) + "\n")
     _heartbeat(ckpt_dir, step)
 
 

@@ -14,6 +14,7 @@ edge-anomaly task, `architecture.mdx:49-53`) + node BCE (aux) + sequence BCE
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import time
 from functools import partial
@@ -29,7 +30,9 @@ import optax
 from flax.training import train_state
 
 from nerrf_tpu.models.joint import JointConfig, NerrfNet
-from nerrf_tpu.train.data import WindowDataset
+from nerrf_tpu.observability import DEFAULT_REGISTRY
+from nerrf_tpu.tracing import DEFAULT_TRACER
+from nerrf_tpu.train.data import WindowDataset, padding_waste_fractions
 from nerrf_tpu.train.metrics import best_f1, roc_auc
 
 
@@ -333,6 +336,12 @@ def evaluate(eval_fn, params, ds: WindowDataset, batch_size: int = 8,
     split is ~300 batches), so this defaults on for accelerator backends;
     the host-slicing path remains for CPU and tiny sets.
     """
+    with DEFAULT_TRACER.span("eval", device=True, samples=len(ds)):
+        return _evaluate(eval_fn, params, ds, batch_size, resident)
+
+
+def _evaluate(eval_fn, params, ds: WindowDataset, batch_size: int = 8,
+              resident: Optional[bool] = None) -> Dict[str, float]:
     n = len(ds)
     if resident is None:
         resident = (jax.default_backend() not in ("cpu",)
@@ -416,7 +425,8 @@ def train_nerrfnet(
     model = NerrfNet(cfg.model)
     rng = jax.random.PRNGKey(cfg.seed)
     rng, init_rng = jax.random.split(rng)
-    state = init_state(model, cfg, train_ds.arrays, init_rng)
+    with DEFAULT_TRACER.span("train_setup", device=True):
+        state = init_state(model, cfg, train_ds.arrays, init_rng)
     n = len(train_ds)
     if log:
         # the same kernel attribution the bench artifacts carry, stamped
@@ -431,41 +441,93 @@ def train_nerrfnet(
     # HBM-resident + device-scheduled fast path when the dataset fits;
     # stream batches from host otherwise
     resident = _fits_resident(train_ds.arrays)
-    if resident:
-        train_step = make_train_step_scheduled(
-            model, cfg, train_ds.arrays, make_idx_schedule(n, cfg))
-    else:
-        train_step = make_train_step(model, cfg)
-    eval_fn = make_eval_fn(model)
+    with DEFAULT_TRACER.span("train_setup", device=True, phase="step_fns"):
+        if resident:
+            train_step = make_train_step_scheduled(
+                model, cfg, train_ds.arrays, make_idx_schedule(n, cfg))
+        else:
+            train_step = make_train_step(model, cfg)
+        eval_fn = make_eval_fn(model)
 
     order_rng = np.random.default_rng(cfg.seed)
     history = []
+    # step-time attribution: padding waste is knowable before the first
+    # step (static shapes make padded slots cost real compute), the
+    # host-blocked / data-wait split only when per-step spans sync — so
+    # the fractions below accumulate only under DEFAULT_TRACER.enabled
+    tracer = DEFAULT_TRACER
+    trace_steps = tracer.enabled
+    bucket_tag = (f"{train_ds.arrays['node_feat'].shape[1]}n/"
+                  f"{train_ds.arrays['edge_src'].shape[1]}e")
+    for kind, frac in padding_waste_fractions(train_ds.arrays).items():
+        DEFAULT_REGISTRY.gauge_set(
+            "train_padding_waste_fraction", frac,
+            labels={"kind": kind, "bucket": bucket_tag},
+            help="fraction of padded capacity carrying no real data")
+    blocked_s = 0.0
+    data_wait_s = 0.0
     # warmup/compile step excluded from timing
     t_start = None
-    for step in range(cfg.num_steps):
-        if resident:
-            state, loss, aux, rng = train_step(state, rng)
-        else:
-            idx = order_rng.choice(n, size=min(cfg.batch_size, n), replace=False)
-            batch = {k: jnp.asarray(v[idx]) for k, v in train_ds.arrays.items()}
-            state, loss, aux, rng = train_step(state, batch, rng)
-        if step == 0:
-            sync_result(loss)
-            t_start = time.perf_counter()
-        if step % cfg.eval_every == 0 or step == cfg.num_steps - 1:
-            history.append({"step": step, "loss": float(loss)})
-            from nerrf_tpu.observability import DEFAULT_REGISTRY
-
-            DEFAULT_REGISTRY.gauge_set("train_step", step,
-                                       help="last completed train step")
-            DEFAULT_REGISTRY.gauge_set("train_loss", float(loss),
-                                       help="joint loss at last logged step")
-            if log:
-                log(f"step {step}: loss={float(loss):.4f} "
-                    + " ".join(f"{k}={float(v):.4f}" for k, v in aux.items()))
-    sync_result(state.params)
+    with tracer.span("train_loop", steps=cfg.num_steps, resident=resident,
+                     bucket=bucket_tag):
+        for step in range(cfg.num_steps):
+            if not resident:
+                dw_cm = tracer.span("data_wait", step=step) if trace_steps \
+                    else contextlib.nullcontext()
+                with dw_cm as dw:
+                    idx = order_rng.choice(
+                        n, size=min(cfg.batch_size, n), replace=False)
+                    batch = {k: jnp.asarray(v[idx])
+                             for k, v in train_ds.arrays.items()}
+                # step 0 excluded: the attribution fractions share the
+                # steps/s convention of measuring steady state only
+                if dw is not None and step > 0:
+                    data_wait_s += dw.dur
+            step_args = (state, rng) if resident else (state, batch, rng)
+            if trace_steps:
+                # fetch-synced step: the span measures until the loss
+                # exists on host (block_until_ready is a no-op on the axon
+                # platform), so dur − dispatch_s IS the host-blocked time
+                with tracer.span("device_step", device=True,
+                                 step=step) as sp:
+                    t_d = time.perf_counter()
+                    state, loss, aux, rng = train_step(*step_args)
+                    dispatch_s = time.perf_counter() - t_d
+                    sync_result(loss)
+                    sp.args["dispatch_s"] = round(dispatch_s, 6)
+                if step > 0:  # step 0 is the compile; see data_wait note
+                    blocked_s += max(sp.dur - dispatch_s, 0.0)
+            else:
+                state, loss, aux, rng = train_step(*step_args)
+            if step == 0:
+                sync_result(loss)
+                t_start = time.perf_counter()
+            if step % cfg.eval_every == 0 or step == cfg.num_steps - 1:
+                history.append({"step": step, "loss": float(loss)})
+                DEFAULT_REGISTRY.gauge_set("train_step", step,
+                                           help="last completed train step")
+                DEFAULT_REGISTRY.gauge_set(
+                    "train_loss", float(loss),
+                    help="joint loss at last logged step")
+                if log:
+                    log(f"step {step}: loss={float(loss):.4f} "
+                        + " ".join(f"{k}={float(v):.4f}"
+                                   for k, v in aux.items()))
+        sync_result(state.params)
     elapsed = time.perf_counter() - (t_start or time.perf_counter())
     steps_per_sec = (cfg.num_steps - 1) / elapsed if elapsed > 0 else 0.0
+    if trace_steps and elapsed > 0 and cfg.num_steps > 1:
+        # same denominator as steps_per_sec (post-step-0 steady state), so
+        # the fractions attribute the time the headline number measures —
+        # dividing by the whole loop would dilute them with compile time
+        DEFAULT_REGISTRY.gauge_set(
+            "train_host_blocked_fraction", blocked_s / elapsed,
+            help="fraction of steady-state train wall spent blocked on "
+                 "device results (fetch-synced device_step spans)")
+        DEFAULT_REGISTRY.gauge_set(
+            "train_data_wait_fraction", data_wait_s / elapsed,
+            help="fraction of steady-state train wall spent assembling or "
+                 "waiting for input batches")
 
     metrics = evaluate(
         eval_fn, state.params, eval_ds if eval_ds is not None else train_ds,
@@ -561,17 +623,21 @@ def train_sharded_stream(
     thread.start()
 
     def next_host_shard():
-        while True:
-            try:
-                item = host_q.get(timeout=5.0)
-            except queue_mod.Empty:
-                if not thread.is_alive():
-                    raise RuntimeError(
-                        "corpus reader thread died without reporting")
-                continue
-            if isinstance(item, BaseException):
-                raise RuntimeError("corpus shard read failed") from item
-            return item
+        # data_wait: host blocked on the disk-reader thread — when this
+        # span dominates the trace the reader, not the chip, is the
+        # bottleneck
+        with DEFAULT_TRACER.span("data_wait", source="shard_queue"):
+            while True:
+                try:
+                    item = host_q.get(timeout=5.0)
+                except queue_mod.Empty:
+                    if not thread.is_alive():
+                        raise RuntimeError(
+                            "corpus reader thread died without reporting")
+                    continue
+                if isinstance(item, BaseException):
+                    raise RuntimeError("corpus shard read failed") from item
+                return item
 
     rng = jax.random.PRNGKey(cfg.seed)
     rng, init_rng = jax.random.split(rng)

@@ -1,0 +1,45 @@
+"""The metrics-name lint (scripts/check_metrics.py) as a tier-1 gate."""
+
+import importlib.util
+import subprocess
+import sys
+
+
+def test_codebase_metrics_are_clean(repo_root):
+    """Every metric name registered in the codebase passes the lint:
+    counters end in _total, no type clashes, every name has help text."""
+    r = subprocess.run(
+        [sys.executable, str(repo_root / "scripts" / "check_metrics.py")],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "clean" in r.stdout
+
+
+def _load_check_metrics(repo_root):
+    spec = importlib.util.spec_from_file_location(
+        "check_metrics", repo_root / "scripts" / "check_metrics.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_lint_rules_fire_on_violations(tmp_path, repo_root):
+    cm = _load_check_metrics(repo_root)
+    pkg = tmp_path / "nerrf_tpu"
+    pkg.mkdir()
+    (pkg / "bad.py").write_text(
+        'REG.counter_inc("events", 1)\n'                       # no _total
+        'REG.gauge_set("events", 2.0, help="clash")\n'         # type clash
+        'REG.histogram_observe("lat_seconds", 0.1)\n'          # no help
+        'NAME = "const_backed_total"\n'
+        'REG.counter_inc(NAME, 1, help="resolved via constant")\n')
+    (tmp_path / "bench.py").write_text("")
+    (tmp_path / "benchmarks").mkdir()
+    metrics = cm.scan(tmp_path)
+    errors = cm.lint(metrics)
+    assert any("missing the _total suffix" in e for e in errors)
+    assert any("conflicting types" in e for e in errors)
+    assert any("lat_seconds" in e and "help" in e for e in errors)
+    # UPPER_CASE constant names resolve to their literal in the same file
+    assert "const_backed_total" in metrics
+    assert not [e for e in errors if "const_backed_total" in e]

@@ -1,7 +1,11 @@
 """Metrics registry, Prometheus rendering, HTTP endpoint, pipeline wiring."""
 
 import json
+import threading
 import urllib.request
+import warnings
+
+import pytest
 
 from nerrf_tpu.observability import (
     DEFAULT_REGISTRY,
@@ -28,6 +32,89 @@ def test_counter_gauge_histogram_render():
     assert 't_latency_seconds_bucket{le="+Inf"} 2' in text
     assert "t_latency_seconds_count 2" in text
     assert reg.value("events_total") == 5
+
+
+def test_label_values_escaped_per_exposition_format():
+    """Backslash, double-quote and newline in label values must render
+    escaped — raw they corrupt every series after them in a scrape."""
+    reg = MetricsRegistry(namespace="esc")
+    reg.counter_inc("paths_total", 1,
+                    labels={"path": 'C:\\tmp\\"log"\nname'}, help="paths")
+    reg.gauge_set("g", 1.0, help="multi\nline help")
+    text = reg.render()
+    assert r'path="C:\\tmp\\\"log\"\nname"' in text
+    # the raw newline must not appear inside any sample line
+    for line in text.splitlines():
+        assert not line.startswith('esc_paths_total{path="C:')  \
+            or line.endswith("} 1")
+    assert "# HELP esc_g multi\\nline help" in text
+
+
+def test_value_reads_histograms():
+    reg = MetricsRegistry()
+    reg.histogram_observe("lat_seconds", 0.2, help="lat")
+    reg.histogram_observe("lat_seconds", 0.4)
+    assert reg.value("lat_seconds") == pytest.approx(0.6)          # sum
+    assert reg.value("lat_seconds", stat="sum") == pytest.approx(0.6)
+    assert reg.value("lat_seconds", stat="count") == 2
+    assert reg.value("lat_seconds", stat="mean") == pytest.approx(0.3)
+    assert reg.value("lat_seconds", labels={"x": "y"}) == 0.0      # no series
+    assert reg.value("never_seen") == 0.0
+    with pytest.raises(ValueError):
+        reg.value("lat_seconds", stat="p99")
+
+
+def test_histogram_bucket_mismatch_warns_and_keeps_registered():
+    reg = MetricsRegistry()
+    reg.histogram_observe("h_seconds", 0.05, buckets=(0.1, 1.0), help="h")
+    with warnings.catch_warnings(record=True) as got:
+        warnings.simplefilter("always")
+        reg.histogram_observe("h_seconds", 0.05, buckets=(0.5,))
+        reg.histogram_observe("h_seconds", 0.05)  # None = registered, silent
+    assert len(got) == 1 and "h_seconds" in str(got[0].message)
+    text = reg.render()
+    assert 'le="0.1"' in text and 'le="0.5"' not in text
+    assert reg.value("h_seconds", stat="count") == 3
+
+
+def test_registry_thread_safety_under_concurrent_render():
+    """Concurrent counter/histogram writers while render() runs: no drops,
+    no corruption, exact totals at the end."""
+    reg = MetricsRegistry(namespace="tsafe")
+    stop = threading.Event()
+    errors = []
+
+    def write(i):
+        try:
+            for _ in range(2000):
+                reg.counter_inc("ops_total", 1, help="ops")
+                reg.histogram_observe("lat_seconds", 0.01,
+                                      labels={"w": str(i)}, help="lat")
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    def render():
+        try:
+            while not stop.is_set():
+                reg.render()
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    renderer = threading.Thread(target=render)
+    writers = [threading.Thread(target=write, args=(i,)) for i in range(4)]
+    renderer.start()
+    for t in writers:
+        t.start()
+    for t in writers:
+        t.join()
+    stop.set()
+    renderer.join(timeout=10)
+    assert not errors
+    assert reg.value("ops_total") == 8000
+    total = sum(reg.value("lat_seconds", labels={"w": str(i)}, stat="count")
+                for i in range(4))
+    assert total == 8000
+    assert "tsafe_ops_total 8000" in reg.render()
 
 
 def test_metrics_server_serves_scrape_and_health():
@@ -68,3 +155,11 @@ def test_pipeline_components_report_to_default_registry(tmp_path):
         st.flush()
     assert DEFAULT_REGISTRY.value("store_compactions_total") > before_comp
     assert "nerrf_store_segments" in DEFAULT_REGISTRY.render()
+    # the tracing spine's dual-write: the ingest/store spans landed in the
+    # per-stage latency histogram under the same registry
+    assert DEFAULT_REGISTRY.value(
+        "stage_latency_seconds", labels={"stage": "ingest_decode"},
+        stat="count") > 0
+    assert DEFAULT_REGISTRY.value(
+        "stage_latency_seconds", labels={"stage": "store_compact"},
+        stat="count") > 0
