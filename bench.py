@@ -516,10 +516,52 @@ def main() -> None:
             "provenance": "python benchmarks/run_tracker_bench.py",
         }
 
+    def _serve():
+        # live smoke (2 streams, ~5 s of serving) so a serve-plane
+        # regression surfaces in EVERY bench artifact, not just when the
+        # checked-in artifact is refreshed; the child is pinned to this
+        # run's resolved backend so it can never hang probing a dead
+        # tunnel.  Falls back to the checked-in CPU artifact on failure.
+        import subprocess
+
+        def surface(r):
+            return {
+                "streams": r.get("streams"),
+                "events_per_sec": r.get("value"),
+                "occupancy_mean": r.get("batch", {}).get("occupancy_mean"),
+                "p99_window_to_alert_ms":
+                    r.get("window_to_alert_latency_ms", {}).get("p99"),
+                "recompiles_after_warmup": r.get("recompiles_after_warmup"),
+                "parity_bit_identical":
+                    r.get("parity", {}).get("bit_identical_to_model_detect"),
+                "backend": r.get("backend"),
+                "smoke": r.get("smoke"),
+                "provenance": r.get("provenance"),
+            }
+
+        try:
+            env = dict(os.environ, JAX_PLATFORMS=backend)
+            r = subprocess.run(
+                [sys.executable,
+                 os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "benchmarks", "run_serve_bench.py"),
+                 "--smoke"],
+                capture_output=True, text=True, timeout=600, env=env)
+            line = r.stdout.strip().splitlines()[-1]
+            return surface(json.loads(line))
+        except Exception as e:  # noqa: BLE001 — fall back to the artifact
+            log(f"[bench] serve smoke failed ({e!r}); surfacing the "
+                "checked-in artifact")
+        p = os.path.join(art_dir, "serve_bench_cpu.json")
+        if not os.path.exists(p):
+            return None
+        return surface(json.load(open(p)))
+
     # per-artifact isolation: one truncated/corrupt JSON on disk must not
     # silently drop the valid artifacts after it
     for key, loader in (("corpus100h", _j100), ("adversarial", _adv),
-                        ("m1_recovery", _recovery), ("tracker", _tracker)):
+                        ("m1_recovery", _recovery), ("tracker", _tracker),
+                        ("serve", _serve)):
         try:
             entry = loader()
             if entry is not None:

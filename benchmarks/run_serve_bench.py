@@ -1,0 +1,207 @@
+#!/usr/bin/env python3
+"""Serve-plane load harness: N concurrent Tracker streams through the
+online detection service's shared device micro-batches.
+
+Measures the quantities docs/serving.md commits to: sustained
+streams×events/s through the full wire path (replay server → grpcio →
+native decode → windowing → shared padded batch → demux), p50/p99
+window-to-alert latency, batch occupancy at the dominant bucket, and
+recompiles after warmup (must be 0).  Every run also asserts the
+acceptance-criterion parity leg: one stream's DetectionResult must be
+bit-identical to the offline `pipeline.model_detect` on the same trace at
+the same bucket.
+
+    python benchmarks/run_serve_bench.py                 # 8 streams
+    python benchmarks/run_serve_bench.py --smoke         # 2 streams, ~5 s
+    python benchmarks/run_serve_bench.py --out results/serve_bench_cpu.json
+
+Prints ONE JSON line (the artifact) on stdout; exits 1 if parity fails or
+a recompile happened after warmup.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+
+def run(streams: int = 8, sim_seconds: float = 90.0,
+        bucket=(256, 512, 128), batch_size: int = 8,
+        close_ms: float = 250.0, smoke: bool = False,
+        log=lambda *a: print(*a, file=sys.stderr, flush=True)) -> dict:
+    """Importable harness body (the tier-1 smoke test calls this
+    in-process).  Returns the artifact dict."""
+    if smoke:
+        streams, sim_seconds = 2, 30.0
+    log = log or (lambda *a: None)
+    import jax
+
+    from nerrf_tpu.data.synth import SimConfig, simulate_trace
+    from nerrf_tpu.ingest.service import TraceReplayServer, TrackerClient
+    from nerrf_tpu.models import JointConfig, NerrfNet
+    from nerrf_tpu.observability import MetricsRegistry
+    from nerrf_tpu.pipeline import model_detect
+    from nerrf_tpu.serve import (
+        OnlineDetectionService,
+        ServeConfig,
+        bucket_tag,
+        init_untrained_params,
+    )
+
+    backend = jax.default_backend()
+    cfg = ServeConfig(
+        buckets=(tuple(bucket),), batch_size=batch_size,
+        batch_close_sec=close_ms / 1000.0,
+        window_sec=15.0, stride_sec=5.0,
+        # the harness measures scoring, not overload shedding: queues deep
+        # enough that nothing drops (drop behavior is tier-1 tested)
+        stream_queue_slots=512, alert_queue_slots=4096,
+        window_deadline_sec=2.0)
+    model = NerrfNet(JointConfig().small)
+    params = init_untrained_params(model, cfg)
+    registry = MetricsRegistry(namespace="bench")
+    window_log: list = []
+    svc = OnlineDetectionService(params, model, cfg=cfg, registry=registry,
+                                 window_log=window_log)
+    t0 = time.perf_counter()
+    svc.start(log=log)
+    warmup_wall = round(time.perf_counter() - t0, 1)
+    log(f"[serve-bench] warmup {warmup_wall}s {svc.warmup_seconds}")
+
+    # one replay server per stream — every event crosses the real wire
+    traces, servers, targets = [], [], []
+    for i in range(streams):
+        tr = simulate_trace(SimConfig(
+            duration_sec=sim_seconds, attack=(i % 2 == 0),
+            attack_start_sec=sim_seconds / 3, num_target_files=4,
+            benign_rate_hz=6.0, seed=1000 + 97 * i))
+        srv = TraceReplayServer(tr.events, tr.strings, batch_size=256)
+        port = srv.start()
+        traces.append(tr)
+        servers.append(srv)
+        targets.append(f"127.0.0.1:{port}")
+    events_total = int(sum(tr.events.num_valid for tr in traces))
+
+    t0 = time.perf_counter()
+    runs = [svc.connect(f"s{i}", targets[i], timeout=300.0)
+            for i in range(streams)]
+    for r in runs:
+        r.done.wait(timeout=600.0)
+    wall = time.perf_counter() - t0
+    errors = {r.stream: repr(r.error) for r in runs if r.error}
+
+    # parity leg: stream s0's serve result vs offline model_detect on the
+    # SAME bytes the service decoded (an independent drain of the same
+    # replay server reconstructs them through the same bridge path)
+    ref_events, ref_strings = TrackerClient(targets[0]).stream(timeout=60.0)
+    from nerrf_tpu.data.loaders import Trace
+
+    offline = model_detect(
+        Trace(events=ref_events, strings=ref_strings, ground_truth=None,
+              labels=None, name="s0"),
+        params, model, ds_cfg=cfg.dataset_config(tuple(bucket)),
+        auto_capacity=False, batch_size=batch_size)
+    served = runs[0].result
+    parity = (
+        served is not None
+        and served.file_scores == offline.file_scores
+        and served.file_window_scores == offline.file_window_scores
+        and served.proc_scores == offline.proc_scores
+        and served.file_bytes == offline.file_bytes
+        and served.threshold == offline.threshold)
+    for srv in servers:
+        srv.stop()
+    svc.stop()
+
+    tag = bucket_tag(tuple(bucket))
+    lat_ms = sorted(1e3 * lat for _, _, lat, _ in window_log)
+
+    def pct(p):
+        return round(lat_ms[min(int(p * len(lat_ms)), len(lat_ms) - 1)], 1) \
+            if lat_ms else None
+
+    occ_mean = registry.value("serve_batch_occupancy",
+                              labels={"bucket": tag}, stat="mean")
+    recompiles = registry.value("serve_recompiles_total",
+                                labels={"bucket": tag})
+    scored = registry.value("serve_windows_scored_total")
+    result = {
+        "metric": "serve_events_per_sec_sustained",
+        "value": round(events_total / wall, 1),
+        "unit": f"events/s across {streams} concurrent wire streams",
+        "backend": backend,
+        "smoke": smoke or None,
+        "streams": streams,
+        "events_total": events_total,
+        "wall_seconds": round(wall, 2),
+        "windows_scored": int(scored),
+        "windows_admitted": int(registry.value(
+            "serve_windows_admitted_total")),
+        "late_windows": int(registry.value("serve_late_windows_total")),
+        "admission_dropped": {
+            reason: int(registry.value("serve_admission_dropped_total",
+                                       labels={"reason": reason}))
+            for reason in ("backpressure", "oversize", "leave", "closed")},
+        "batch": {
+            "size": batch_size,
+            "close_ms": close_ms,
+            "dominant_bucket": tag,
+            "occupancy_mean": round(occ_mean, 2),
+            "batches": int(registry.value(
+                "serve_batch_occupancy", labels={"bucket": tag},
+                stat="count")),
+        },
+        "window_to_alert_latency_ms": {
+            "p50": pct(0.50), "p99": pct(0.99),
+            "max": round(lat_ms[-1], 1) if lat_ms else None},
+        "recompiles_after_warmup": int(recompiles),
+        "warmup_seconds": {"wall": warmup_wall, **svc.warmup_seconds},
+        "parity": {
+            "stream": "s0",
+            "bit_identical_to_model_detect": bool(parity),
+            "files_scored": len(offline.file_scores)},
+        "stream_errors": errors or None,
+        "provenance": "python benchmarks/run_serve_bench.py"
+                      + (" --smoke" if smoke else ""),
+    }
+    return result
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--streams", type=int, default=8)
+    ap.add_argument("--seconds", type=float, default=90.0,
+                    help="simulated seconds of trace per stream")
+    ap.add_argument("--bucket", default="256x512x128", metavar="NxExS")
+    ap.add_argument("--batch-size", type=int, default=8)
+    ap.add_argument("--close-ms", type=float, default=250.0)
+    ap.add_argument("--smoke", action="store_true",
+                    help="2 streams, short traces (~5 s of serving)")
+    ap.add_argument("--out", default=None, metavar="FILE",
+                    help="also write the artifact JSON here")
+    args = ap.parse_args(argv)
+
+    result = run(streams=args.streams, sim_seconds=args.seconds,
+                 bucket=tuple(int(x) for x in args.bucket.split("x")),
+                 batch_size=args.batch_size, close_ms=args.close_ms,
+                 smoke=args.smoke)
+    line = json.dumps(result)
+    print(line)
+    if args.out:
+        os.makedirs(os.path.dirname(os.path.abspath(args.out)), exist_ok=True)
+        with open(args.out, "w") as f:
+            f.write(json.dumps(result, indent=2) + "\n")
+    ok = (result["parity"]["bit_identical_to_model_detect"]
+          and result["recompiles_after_warmup"] == 0
+          and not result["stream_errors"])
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -384,6 +384,145 @@ def cmd_serve(args) -> int:
     return 0
 
 
+def cmd_serve_detect(args) -> int:
+    """The online AI pod: admit N concurrent Tracker streams, window each,
+    and score cross-stream micro-batches through one warmed device program
+    per capacity bucket (nerrf_tpu/serve, docs/serving.md).  Streams come
+    from --target endpoints (live trackers) and/or --trace files (each
+    served through an in-process TraceReplayServer, so the full wire
+    protocol is exercised either way).  Readiness (/readyz on the metrics
+    port) flips only after every configured bucket is compiled."""
+    from nerrf_tpu.utils import enable_compilation_cache, ensure_backend_or_cpu
+
+    enable_compilation_cache()
+    if not args.no_probe:
+        ensure_backend_or_cpu("nerrf-serve", timeout_sec=75.0)
+    import dataclasses as _dc
+
+    from nerrf_tpu.models import JointConfig, NerrfNet
+    from nerrf_tpu.observability import MetricsServer
+    from nerrf_tpu.serve import (
+        OnlineDetectionService,
+        ServeConfig,
+        init_untrained_params,
+    )
+
+    cfg_kwargs = dict(
+        batch_size=args.batch_size,
+        batch_close_sec=args.close_ms / 1000.0,
+        window_deadline_sec=args.deadline_sec,
+        stream_queue_slots=args.queue_slots,
+    )
+    if args.buckets:
+        cfg_kwargs["buckets"] = tuple(
+            tuple(int(x) for x in b.split("x")) for b in args.buckets)
+    cfg = ServeConfig(**cfg_kwargs)
+
+    if args.model_dir:
+        from nerrf_tpu.train.checkpoint import load_calibration, load_checkpoint
+
+        params, model_cfg = load_checkpoint(args.model_dir)
+        model = NerrfNet(model_cfg)
+        calib = load_calibration(args.model_dir)
+        if calib.get("node_threshold") is not None:
+            cfg = _dc.replace(cfg, threshold=calib["node_threshold"])
+    else:
+        _log("no --model-dir: serving an UNTRAINED small detector "
+             "(load testing only — scores carry no meaning)")
+        model = NerrfNet(JointConfig().small)
+        params = init_untrained_params(model, cfg)
+
+    service = OnlineDetectionService(params, model, cfg=cfg)
+    metrics = None
+    if args.metrics_port >= 0:
+        # readiness is live from the first probe: k8s sees "booting" (503)
+        # during the warmup sweep below, then "ready"
+        metrics = MetricsServer(host="0.0.0.0", port=args.metrics_port,
+                                ready_check=service.ready)
+        _log(f"metrics on :{metrics.port} (/healthz, /readyz)")
+    _log(f"warming {len(cfg.buckets)} bucket programs…")
+    service.start(log=_log)
+
+    replays = []
+    targets = [(f"target{i}", t) for i, t in enumerate(args.target or [])]
+    try:
+        for i, path in enumerate(args.trace or []):
+            from nerrf_tpu.ingest.service import TraceReplayServer
+
+            tr = _load_any_trace(path)
+            rs = TraceReplayServer(tr.events, tr.strings,
+                                   batch_size=args.frame_events)
+            port = rs.start()
+            replays.append(rs)
+            targets.append((f"trace{i}:{Path(path).stem}",
+                            f"127.0.0.1:{port}"))
+        if not targets:
+            _log("nothing to serve: pass --target and/or --trace")
+            return 2
+        runs = [service.connect(name, addr, timeout=args.stream_timeout,
+                                follow=args.follow)
+                for name, addr in targets]
+        _log(f"{len(runs)} streams admitted"
+             + (" (follow: reconnect at stream end)" if args.follow else ""))
+        deadline = time.monotonic() + args.duration if args.duration > 0 \
+            else None
+        for run in runs:
+            run.done.wait(timeout=None if deadline is None
+                          else max(deadline - time.monotonic(), 0.1))
+
+        out_dir = Path(args.out) if args.out else None
+        if out_dir:
+            out_dir.mkdir(parents=True, exist_ok=True)
+        summary = {"streams": {}, "alerts": 0}
+        for run in runs:
+            det = run.result
+            entry = {"done": run.done.is_set(),
+                     "error": repr(run.error) if run.error else None}
+            if det is not None:
+                entry.update(
+                    detector=det.detector, threshold=det.threshold,
+                    files_scored=len(det.file_scores),
+                    files_flagged=len(det.flagged_files()))
+                if out_dir:
+                    safe = run.stream.replace("/", "_").replace(":", "_")
+                    (out_dir / f"detect_{safe}.json").write_text(json.dumps({
+                        "stream": run.stream,
+                        "detector": det.detector,
+                        "threshold": det.threshold,
+                        "file_scores": det.file_scores,
+                        "proc_scores": det.proc_scores,
+                    }, indent=2))
+            summary["streams"][run.stream] = entry
+        alerts = service.sink.drain()
+        summary["alerts"] = len(alerts)
+        if out_dir:
+            with (out_dir / "alerts.jsonl").open("w") as f:
+                for a in alerts:
+                    f.write(json.dumps({
+                        "stream": a.stream, "window": a.window_idx,
+                        "max_prob": round(a.max_prob, 4),
+                        "hot": a.hot, "late": a.late,
+                        "latency_ms": round(
+                            (a.t_scored - a.t_admit) * 1e3, 1),
+                    }) + "\n")
+        from nerrf_tpu.observability import DEFAULT_REGISTRY
+
+        summary["windows_scored"] = DEFAULT_REGISTRY.value(
+            "serve_windows_scored_total")
+        summary["admission_dropped"] = {
+            reason: DEFAULT_REGISTRY.value(
+                "serve_admission_dropped_total", labels={"reason": reason})
+            for reason in ("backpressure", "oversize", "leave", "closed")}
+        print(json.dumps(summary, indent=2))
+        return 0
+    finally:
+        service.stop()
+        for rs in replays:
+            rs.stop()
+        if metrics:
+            metrics.close()
+
+
 def cmd_ingest(args) -> int:
     """Drain a tracker's StreamEvents into a trace store (the AI-side ingest
     pod: gRPC → native decode → time-bucketed segments).  Blocks are appended
@@ -559,6 +698,56 @@ def main(argv=None) -> int:
                    help="write a Chrome-trace JSON of the serve session's "
                         "host spans on exit")
     p.set_defaults(fn=cmd_serve)
+
+    p = sub.add_parser("serve-detect",
+                       help="online detection service: score N tracker "
+                            "streams through shared device micro-batches")
+    p.add_argument("--model-dir", default=None,
+                   help="trained detector checkpoint (default: an untrained "
+                        "small model, for load testing only)")
+    p.add_argument("--target", action="append", default=None,
+                   metavar="HOST:PORT",
+                   help="tracker endpoint to admit as one stream "
+                        "(repeatable)")
+    p.add_argument("--trace", action="append", default=None, metavar="FILE",
+                   help="trace file to serve through an in-process replay "
+                        "server and admit as one stream (repeatable)")
+    p.add_argument("--buckets", nargs="*", default=None, metavar="NxExS",
+                   help="capacity-bucket ladder, e.g. 256x512x128 "
+                        "1024x2048x128 (default: the warmup ladder)")
+    p.add_argument("--batch-size", type=int, default=8,
+                   help="padded device batch slots per launch")
+    p.add_argument("--close-ms", type=float, default=50.0,
+                   help="batch-close deadline: fire a partial batch after "
+                        "the oldest window waited this long")
+    p.add_argument("--deadline-sec", type=float, default=2.0,
+                   help="per-window admit→alert SLO budget (late windows "
+                        "still score, counted)")
+    p.add_argument("--queue-slots", type=int, default=64,
+                   help="per-stream bounded admission queue (drop-oldest)")
+    p.add_argument("--frame-events", type=int, default=256,
+                   help="events per wire frame for --trace replay servers")
+    p.add_argument("--stream-timeout", type=float, default=300.0,
+                   help="gRPC deadline per stream drain")
+    p.add_argument("--follow", action="store_true",
+                   help="resident mode (the serve pod): finalize and "
+                        "reconnect each stream when it ends instead of "
+                        "exiting — pair with a long --stream-timeout")
+    p.add_argument("--duration", type=float, default=0,
+                   help="stop waiting after N seconds (0 = until every "
+                        "stream ends; with --follow that is forever)")
+    p.add_argument("--metrics-port", type=int, default=9092,
+                   help="Prometheus /metrics + /healthz + /readyz port "
+                        "(-1 disables); default 9092 so serve (9090) and "
+                        "ingest (9091) coexist on one host")
+    p.add_argument("--out", default=None, metavar="DIR",
+                   help="write per-stream detection JSON + alerts.jsonl")
+    p.add_argument("--no-probe", action="store_true",
+                   help="skip the bounded accelerator-reachability probe")
+    p.add_argument("--trace-out", default=None, metavar="FILE",
+                   help="write a Chrome-trace JSON of the serve session's "
+                        "host spans on exit")
+    p.set_defaults(fn=cmd_serve_detect)
 
     p = sub.add_parser("trace", help="per-stage latency table from a "
                                      "--trace-out Chrome-trace file")

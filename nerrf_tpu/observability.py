@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import contextlib
 import http.server
+import json
 import threading
 import time
 import warnings
@@ -190,15 +191,46 @@ DEFAULT_REGISTRY = MetricsRegistry()
 
 
 class MetricsServer:
-    """Serves /metrics (text exposition) and /healthz from a daemon thread."""
+    """Serves /metrics (text exposition), /healthz and /readyz from a
+    daemon thread.
+
+    The two probes answer different questions (and the K8s manifests in
+    deploy/ wire them to different probe types):
+
+      * ``/healthz`` — LIVENESS: the process is up and serving HTTP.
+        Always 200 once the server thread runs; a failure means restart me.
+      * ``/readyz`` — READINESS: the subsystem behind this server is ready
+        for traffic.  Driven by ``ready_check`` (e.g. the serve plane's
+        "warmup done + admission open"); 503 while booting or draining so
+        the Service stops routing, WITHOUT restarting a pod that is merely
+        still compiling its bucket programs.  With no ``ready_check`` the
+        server is ready as soon as it is live.
+
+    ``ready_check`` returns either a bool or ``(bool, reason)``; it is
+    called per probe and must be cheap.  An exception counts as unready
+    (the reason is the exception) — a broken check must fail closed.
+    """
 
     def __init__(self, registry: Optional[MetricsRegistry] = None,
-                 host: str = "127.0.0.1", port: int = 0) -> None:
+                 host: str = "127.0.0.1", port: int = 0,
+                 ready_check=None) -> None:
         registry = registry or DEFAULT_REGISTRY
         start_ts = time.time()
 
+        def readiness() -> Tuple[bool, str]:
+            if ready_check is None:
+                return True, "ok"
+            try:
+                got = ready_check()
+            except Exception as e:  # noqa: BLE001 — fail closed
+                return False, f"{type(e).__name__}: {e}"
+            if isinstance(got, tuple):
+                return bool(got[0]), str(got[1])
+            return bool(got), "ok" if got else "not ready"
+
         class Handler(http.server.BaseHTTPRequestHandler):
             def do_GET(self) -> None:  # noqa: N802 (stdlib API)
+                code = 200
                 if self.path.startswith("/metrics"):
                     body = registry.render().encode()
                     ctype = "text/plain; version=0.0.4; charset=utf-8"
@@ -208,10 +240,19 @@ class MetricsServer:
                         % (time.time() - start_ts)
                     ).encode()
                     ctype = "application/json"
+                elif self.path.startswith("/readyz"):
+                    ok, reason = readiness()
+                    code = 200 if ok else 503
+                    body = (json.dumps({
+                        "status": "ready" if ok else "unready",
+                        "reason": reason,
+                        "uptime_sec": round(time.time() - start_ts, 1),
+                    }) + "\n").encode()
+                    ctype = "application/json"
                 else:
                     self.send_error(404)
                     return
-                self.send_response(200)
+                self.send_response(code)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()

@@ -229,6 +229,104 @@ def warmup_detector(params, model: NerrfNet,
     return times
 
 
+def pad_batch(samples: list, batch_size: int) -> Dict[str, np.ndarray]:
+    """Stack window samples into one fixed-shape device batch, zero-padding
+    the ragged tail (a tail-shaped batch would recompile eval per trace
+    size).  Shared by `model_detect` and the serve micro-batcher — the
+    padding is part of the serve plane's bit-parity contract, so there is
+    exactly one implementation."""
+    pad = batch_size - len(samples)
+    return {
+        k: np.concatenate(
+            [np.stack([s[k] for s in samples])]
+            + ([np.zeros((pad,) + samples[0][k].shape,
+                         samples[0][k].dtype)] if pad else []))
+        for k in samples[0]
+    }
+
+
+def accumulate_node_scores(
+    probs: np.ndarray,
+    node_type: np.ndarray,
+    node_key: np.ndarray,
+    node_mask: np.ndarray,
+    ino_path: Dict[int, str],
+    pid_comm: Dict[int, str],
+    window_scores: Dict[str, list],
+    proc_scores: Dict[str, float],
+) -> None:
+    """Fold ONE scored window's per-node probabilities into the running
+    per-path window-score lists and per-process maxima.
+
+    Shared by `model_detect` (offline, in window order) and the serve
+    subsystem's finalize step (`nerrf_tpu.serve.service`, which replays its
+    demuxed windows through this in the same window order) — one code path
+    is what makes the online service's DetectionResult bit-identical to the
+    offline one on the same windows."""
+    for slot in np.nonzero(node_mask)[0]:
+        p = float(probs[slot])
+        key = int(node_key[slot])
+        if node_type[slot] == NODE_TYPE_FILE:
+            path = ino_path.get(key)
+            if path is not None:
+                window_scores.setdefault(path, []).append(p)
+        elif node_type[slot] == NODE_TYPE_PROCESS:
+            name = f"{key}:{pid_comm.get(key, '?')}"
+            proc_scores[name] = max(proc_scores.get(name, 0.0), p)
+
+
+def finalize_detection(
+    trace: Trace,
+    window_scores: Dict[str, list],
+    proc_scores: Dict[str, float],
+    agg: str = "max",
+    threshold: Optional[float] = None,
+    detector: str = "model",
+    ino_path: Optional[Dict[int, str]] = None,
+) -> DetectionResult:
+    """Accumulated window node scores → the final DetectionResult: byte
+    accounting, the mutation gate, and window→file aggregation.  The one
+    implementation of `model_detect`'s decision tail, shared with the serve
+    path (same bit-parity argument as `accumulate_node_scores`).
+
+    ``ino_path`` lets callers that already built the inode→path map for
+    score accumulation skip a second full-trace pass here."""
+    if ino_path is None:
+        ino_path = _inode_to_path(trace)
+    file_bytes: Dict[str, float] = {}
+    ev = trace.events
+    mutated: set = set()
+    for i in range(len(ev)):
+        if not ev.valid[i]:
+            continue
+        if ev.inode[i] != 0:
+            path = ino_path[int(ev.inode[i])]
+            file_bytes[path] = file_bytes.get(path, 0.0) + float(ev.bytes[i])
+        if int(ev.syscall[i]) in MUTATING_SYSCALLS:
+            # gate on the inode-canonical path first (file_scores is keyed
+            # on it via _inode_to_path); raw event strings as well, since a
+            # rename's OLD name is a distinct undo target
+            if ev.inode[i] != 0:
+                mutated.add(ino_path[int(ev.inode[i])])
+            for pid_field in (ev.path_id[i], ev.new_path_id[i]):
+                p = trace.strings.lookup(int(pid_field))
+                if p:
+                    mutated.add(p)
+    # Undo candidacy requires mutation: a file nothing ever wrote, renamed
+    # or unlinked has no pre-attack state to restore — rolling it back is a
+    # false-positive undo BY DEFINITION.  The model rightly scores recon
+    # reads (/etc/passwd, /proc/net/tcp) as attack-involved, and that
+    # signal stays visible in file_window_scores; it just cannot nominate
+    # them for rollback.  (Measured: every standard-scenario FP the r2/r3
+    # evals charged to the model was a never-mutated recon read.)
+    file_scores = {p: aggregate_window_scores(ws, agg)
+                   for p, ws in window_scores.items() if p in mutated}
+    return DetectionResult(file_scores, proc_scores, file_bytes,
+                           detector=detector,
+                           file_window_scores=window_scores,
+                           threshold=0.5 if threshold is None else threshold)
+
+
 def model_detect(
     trace: Trace,
     params,
@@ -299,63 +397,20 @@ def model_detect(
 
     window_scores: Dict[str, list] = {}
     proc_scores: Dict[str, float] = {}
-    file_bytes: Dict[str, float] = {}
     for i in range(0, len(samples), batch_size):
         chunk = samples[i : i + batch_size]
-        pad = batch_size - len(chunk)  # fixed batch shape: a ragged tail
-        batch = {                      # would recompile eval per trace size
-            k: jnp.asarray(np.concatenate(
-                [np.stack([s[k] for s in chunk])]
-                + ([np.zeros((pad,) + chunk[0][k].shape,
-                             chunk[0][k].dtype)] if pad else [])))
-            for k in chunk[0]
-        }
+        batch = {k: jnp.asarray(v)
+                 for k, v in pad_batch(chunk, batch_size).items()}
         with trace_span("detect_score", device=True, windows=len(chunk)):
             out = jax.device_get(eval_fn(params, batch))
         probs = 1.0 / (1.0 + np.exp(-out["node_logit"]))
         for j, s in enumerate(chunk):
-            mask = s["node_mask"]
-            for slot in np.nonzero(mask)[0]:
-                p = float(probs[j, slot])
-                key = int(s["node_key"][slot])
-                if s["node_type"][slot] == NODE_TYPE_FILE:
-                    path = ino_path.get(key)
-                    if path is not None:
-                        window_scores.setdefault(path, []).append(p)
-                elif s["node_type"][slot] == NODE_TYPE_PROCESS:
-                    name = f"{key}:{pid_comm.get(key, '?')}"
-                    proc_scores[name] = max(proc_scores.get(name, 0.0), p)
-    ev = trace.events
-    mutated: set = set()
-    for i in range(len(ev)):
-        if not ev.valid[i]:
-            continue
-        if ev.inode[i] != 0:
-            path = ino_path[int(ev.inode[i])]
-            file_bytes[path] = file_bytes.get(path, 0.0) + float(ev.bytes[i])
-        if int(ev.syscall[i]) in MUTATING_SYSCALLS:
-            # gate on the inode-canonical path first (file_scores is keyed
-            # on it via _inode_to_path); raw event strings as well, since a
-            # rename's OLD name is a distinct undo target
-            if ev.inode[i] != 0:
-                mutated.add(ino_path[int(ev.inode[i])])
-            for pid_field in (ev.path_id[i], ev.new_path_id[i]):
-                p = trace.strings.lookup(int(pid_field))
-                if p:
-                    mutated.add(p)
-    # Undo candidacy requires mutation: a file nothing ever wrote, renamed
-    # or unlinked has no pre-attack state to restore — rolling it back is a
-    # false-positive undo BY DEFINITION.  The model rightly scores recon
-    # reads (/etc/passwd, /proc/net/tcp) as attack-involved, and that
-    # signal stays visible in file_window_scores; it just cannot nominate
-    # them for rollback.  (Measured: every standard-scenario FP the r2/r3
-    # evals charged to the model was a never-mutated recon read.)
-    file_scores = {p: aggregate_window_scores(ws, agg)
-                   for p, ws in window_scores.items() if p in mutated}
-    return DetectionResult(file_scores, proc_scores, file_bytes,
-                           detector=f"model[{agg}]",
-                           file_window_scores=window_scores,
-                           threshold=0.5 if threshold is None else threshold)
+            accumulate_node_scores(probs[j], s["node_type"], s["node_key"],
+                                   s["node_mask"], ino_path, pid_comm,
+                                   window_scores, proc_scores)
+    return finalize_detection(trace, window_scores, proc_scores, agg=agg,
+                              threshold=threshold, detector=f"model[{agg}]",
+                              ino_path=ino_path)
 
 
 def attack_touched_files(trace: Trace) -> tuple:

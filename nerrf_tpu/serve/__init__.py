@@ -1,0 +1,35 @@
+"""Online detection serving: continuous cross-stream micro-batching on one
+device program.  See docs/serving.md for the architecture and knobs."""
+
+from nerrf_tpu.serve.alerts import AlertSink, WindowAlert
+from nerrf_tpu.serve.batcher import MicroBatcher, ScoredWindow, WindowRequest
+from nerrf_tpu.serve.config import (
+    Bucket,
+    ServeConfig,
+    bucket_tag,
+    select_bucket,
+)
+from nerrf_tpu.serve.service import (
+    OnlineDetectionService,
+    StreamHandle,
+    StreamRun,
+    init_untrained_params,
+)
+from nerrf_tpu.serve.windower import StreamWindower
+
+__all__ = [
+    "AlertSink",
+    "Bucket",
+    "MicroBatcher",
+    "OnlineDetectionService",
+    "ScoredWindow",
+    "ServeConfig",
+    "StreamHandle",
+    "StreamRun",
+    "StreamWindower",
+    "WindowAlert",
+    "WindowRequest",
+    "bucket_tag",
+    "init_untrained_params",
+    "select_bucket",
+]

@@ -1,0 +1,85 @@
+"""Alert fan-out for the online detection service.
+
+Two granularities leave the demux stage:
+
+  * `WindowAlert` — per scored window, emitted the moment any node
+    probability crosses the operating threshold: the low-latency signal a
+    responder or auto-planner watches.  Delivery is a *bounded* queue with
+    drop-on-full (counted as ``nerrf_serve_demux_overflows_total``): a slow
+    alert consumer can lose alerts, never stall the scoring plane.
+  * per-stream `DetectionResult` at stream leave — the exact offline
+    artifact (`pipeline.model_detect` parity), ready for
+    `pipeline.build_undo_domain` → the MCTS planner.  Subclass or wrap
+    `AlertSink.on_detection` to hand off automatically.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+
+@dataclasses.dataclass
+class WindowAlert:
+    """One hot window.  ``hot`` carries (node_kind, host_key, prob) —
+    host keys are inodes for files and pids for processes; consumers
+    resolve paths against the stream's trace (the mapping is only final at
+    stream end, when renames have settled)."""
+
+    stream: str
+    window_idx: int
+    lo_ns: int
+    hi_ns: int
+    max_prob: float
+    hot: List[Tuple[str, int, float]]
+    t_admit: float
+    t_scored: float
+    late: bool
+
+
+class AlertSink:
+    """Bounded, never-blocking alert queue + per-stream detection capture."""
+
+    def __init__(self, slots: int = 256, registry=None) -> None:
+        if registry is None:
+            from nerrf_tpu.observability import DEFAULT_REGISTRY
+
+            registry = DEFAULT_REGISTRY
+        self._reg = registry
+        self._lock = threading.Lock()
+        self._alerts: deque = deque(maxlen=max(slots, 1))
+        self.detections: Dict[str, object] = {}
+
+    def emit(self, alert: WindowAlert) -> bool:
+        """Enqueue; False (and a counted overflow) when a stale alert was
+        evicted to make room — the deque keeps the *newest* alerts, the
+        same newest-evidence-wins policy as admission drop-oldest."""
+        with self._lock:
+            overflow = len(self._alerts) == self._alerts.maxlen
+            self._alerts.append(alert)
+        if overflow:
+            self._reg.counter_inc(
+                "serve_demux_overflows_total",
+                help="window alerts evicted because the alert sink was full "
+                     "(slow consumer); scoring is unaffected")
+        return not overflow
+
+    def on_detection(self, stream: str, detection) -> None:
+        """Stream-leave hook: receives the final DetectionResult.  The
+        default keeps it for collection (CLI/bench); override to chain into
+        build_undo_domain/make_planner for automatic response."""
+        with self._lock:
+            self.detections[stream] = detection
+
+    def drain(self, max_n: Optional[int] = None) -> List[WindowAlert]:
+        out: List[WindowAlert] = []
+        with self._lock:
+            while self._alerts and (max_n is None or len(out) < max_n):
+                out.append(self._alerts.popleft())
+        return out
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._alerts)

@@ -1,0 +1,125 @@
+"""Serving configuration: capacity-bucket ladder, batch-close policy,
+backpressure knobs.
+
+The online service admits windows from many streams and packs those that
+land in the same capacity bucket into one shared padded device batch, so
+the knobs here trade latency (batch-close deadline) against occupancy
+(windows per device program launch) against memory (queue bounds).  See
+docs/serving.md for the measured guidance.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+from nerrf_tpu.graph import GraphConfig
+from nerrf_tpu.train.data import DatasetConfig
+
+# (max_nodes, max_edges, max_seqs) capacity bucket.
+Bucket = Tuple[int, int, int]
+
+# Default serving ladder: the warmup cross-product ladder
+# (pipeline.DETECTOR_WARMUP_BUCKETS) prefixed with the corpus-fitted
+# training bucket — live replay/test streams at synthetic density land
+# there, while real-eBPF density climbs the warmup rungs.  Every bucket in
+# the configured set is compiled at service start; a window that fits NO
+# configured bucket is rejected at admission (counted), never compiled —
+# that is the no-recompiles-after-warmup contract.
+def _default_buckets() -> Tuple[Bucket, ...]:
+    from nerrf_tpu.pipeline import DETECTOR_WARMUP_BUCKETS
+
+    return ((256, 512, 128),) + tuple(DETECTOR_WARMUP_BUCKETS)
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    """Knobs of the online detection service (one device program per
+    capacity bucket, shared across streams)."""
+
+    # capacity buckets compiled at start; admission rejects windows that
+    # fit none of them (no recompiles outside this set, ever)
+    buckets: Tuple[Bucket, ...] = dataclasses.field(
+        default_factory=_default_buckets)
+    # padded device batch shape: every launch is exactly this many window
+    # slots (short batches are zero-padded, same as offline model_detect)
+    batch_size: int = 8
+    # close a bucket's batch when this many windows are pending (0 → use
+    # batch_size)...
+    target_occupancy: int = 0
+    # ...or when the oldest pending window has waited this long, whichever
+    # first (the deadline half of the batch-close policy)
+    batch_close_sec: float = 0.05
+    # per-window end-to-end budget (admit → demux); windows scored after it
+    # still deliver, but count into serve_late_windows_total
+    window_deadline_sec: float = 2.0
+    # per-stream bounded admission queue; overflowing drops that stream's
+    # OLDEST pending window (newest evidence wins under sustained overload)
+    stream_queue_slots: int = 64
+    # bounded alert fan-out queue; a slow alert consumer drops (counted),
+    # never blocks the demux thread
+    alert_queue_slots: int = 256
+    # closed-but-not-demuxed batches allowed per bucket; bounds device-side
+    # queueing so one hot bucket cannot monopolize the program queue
+    max_inflight_batches: int = 2
+    # windowing (mirrors GraphConfig defaults; serving must window exactly
+    # like the offline path or parity dies)
+    window_sec: float = 45.0
+    stride_sec: float = 15.0
+    seq_len: int = 100
+    min_events: int = 4
+    # detection operating point
+    agg: str = "max"
+    threshold: Optional[float] = None
+    # compile every configured bucket at start() (readiness gates on it)
+    warmup_on_start: bool = True
+
+    @property
+    def occupancy(self) -> int:
+        return self.target_occupancy or self.batch_size
+
+    def dataset_config(self, bucket: Bucket) -> DatasetConfig:
+        """The DatasetConfig a window lowered into ``bucket`` uses — THE
+        shape authority: warmup, admission lowering, and the offline parity
+        reference (model_detect with auto_capacity=False) must all build
+        through here so the compiled program cache is keyed consistently."""
+        n, e, s = bucket
+        return DatasetConfig(
+            graph=GraphConfig(window_sec=self.window_sec,
+                              stride_sec=self.stride_sec,
+                              max_nodes=n, max_edges=e),
+            seq_len=self.seq_len, max_seqs=s, min_events=self.min_events)
+
+
+def bucket_tag(bucket: Bucket) -> str:
+    """Human/metric label for a bucket, matching warmup_detector's tags."""
+    return f"{bucket[0]}n/{bucket[1]}e/{bucket[2]}s"
+
+
+def select_bucket(need_nodes: int, need_edges: int, need_seqs: int,
+                  buckets: Tuple[Bucket, ...]) -> Optional[Bucket]:
+    """Smallest configured bucket covering the window's exact needs
+    (GraphConfig.fit's power-of-two rungs ARE the ladder entries, so
+    first-fit on the capacity-sorted ladder lands on the same bucket fit
+    would, without ever minting a shape outside the compiled set).
+
+    Node/edge overflow is a hard miss (lowering would silently drop
+    events — the blindness auto-capacity exists to prevent), so a window
+    whose graph fits NO configured bucket returns None and the caller must
+    reject it, never resize.  Sequence overflow is soft: the lowering keeps
+    the ``max_seqs`` *densest* per-file sequences (train/data.py), exactly
+    like the offline path at a fixed DatasetConfig — so when no bucket
+    covers the file count, the smallest graph-fitting rung still wins (a
+    padded slot costs as much device compute as a real one; climbing to an
+    8× graph rung to buy sequence slots is the wrong trade), taking the
+    most sequence slots available WITHIN that rung."""
+    fits_graph = [b for b in sorted(buckets)
+                  if b[0] >= need_nodes and b[1] >= need_edges]
+    if not fits_graph:
+        return None
+    for b in fits_graph:
+        if b[2] >= need_seqs:
+            return b
+    rung = fits_graph[0][:2]
+    return max((b for b in fits_graph if b[:2] == rung),
+               key=lambda b: b[2])

@@ -1,0 +1,479 @@
+"""The online detection service: N tracker streams → one device program.
+
+`OnlineDetectionService` is the serving plane the reference's architecture
+spec calls the online AI pod (`architecture.mdx`), built Sebulba-style
+(arXiv:2104.06272): per-stream actor threads drain the Tracker wire
+protocol (`ingest.TrackerClient`), window and lower their own events on
+host (`serve.windower` + the shared `train.data.window_sample`), and a
+central `serve.batcher.MicroBatcher` packs same-capacity-bucket windows
+from *different* streams into shared padded batches for the one vmapped
+NerrfNet eval program per bucket — all compiled at `start()`
+(no recompiles after warmup; windows outside the bucket ladder are
+rejected at admission, counted, never compiled).
+
+Bit-parity contract: replaying one stream through
+``join → feed… → leave`` yields a `DetectionResult` whose scores are
+bit-identical to `pipeline.model_detect` on the accumulated trace at the
+same bucket's `DatasetConfig` — both paths share the per-window lowering,
+the fixed-shape batch padding, the sigmoid, and the aggregation tail
+(`pipeline.accumulate_node_scores` / `finalize_detection`).  The serve
+bench (`benchmarks/run_serve_bench.py`) asserts it on every run.
+
+Degradation: per-stream bounded admission (drop-OLDEST, counted), a
+bounded alert sink (drop-on-full, counted), deadline-based batch close,
+per-bucket in-flight limits, and clean stream join/leave while batches
+are in flight.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from collections import OrderedDict
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from nerrf_tpu.data.loaders import Trace
+from nerrf_tpu.graph.builder import NODE_TYPE_FILE, measure_window
+from nerrf_tpu.models import NerrfNet
+from nerrf_tpu.pipeline import (
+    DetectionResult,
+    _inode_to_path,
+    _pid_to_comm,
+    accumulate_node_scores,
+    finalize_detection,
+)
+from nerrf_tpu.schema import EventArrays, StringTable
+from nerrf_tpu.serve.alerts import AlertSink, WindowAlert
+from nerrf_tpu.serve.batcher import MicroBatcher, ScoredWindow, WindowRequest
+from nerrf_tpu.serve.config import ServeConfig, bucket_tag, select_bucket
+from nerrf_tpu.serve.windower import StreamWindower
+from nerrf_tpu.tracing import span as trace_span
+from nerrf_tpu.train.data import window_sample, windows_of_trace
+from nerrf_tpu.train.loop import make_eval_fn
+
+
+class StreamHandle:
+    """One admitted stream: its windower, live-request ledger, and scored
+    windows.  ``cond`` guards the ledger; `leave` waits on it."""
+
+    def __init__(self, stream_id: str, cfg: ServeConfig) -> None:
+        self.id = stream_id
+        self.windower = StreamWindower(window_sec=cfg.window_sec,
+                                       stride_sec=cfg.stride_sec)
+        self.cond = threading.Condition()
+        self.live: "OrderedDict[int, WindowRequest]" = OrderedDict()
+        self.scored: List[ScoredWindow] = []
+        self.admitted = 0
+        self.dropped = 0
+        self.failed = 0
+        self.skipped = 0
+        self.rejected = 0
+        self.closing = False
+
+
+@dataclasses.dataclass
+class StreamRun:
+    """A `connect`-spawned drain: result or error lands when the wire
+    stream ends and the stream has left."""
+
+    stream: str
+    thread: threading.Thread
+    done: threading.Event
+    result: Optional[DetectionResult] = None
+    error: Optional[BaseException] = None
+
+
+class OnlineDetectionService:
+    def __init__(
+        self,
+        params,
+        model: NerrfNet,
+        cfg: Optional[ServeConfig] = None,
+        registry=None,
+        alert_sink: Optional[AlertSink] = None,
+        window_log: Optional[list] = None,
+    ) -> None:
+        if registry is None:
+            from nerrf_tpu.observability import DEFAULT_REGISTRY
+
+            registry = DEFAULT_REGISTRY
+        self.cfg = cfg or ServeConfig()
+        self._params = params
+        self._model = model
+        self._eval_fn = make_eval_fn(model)
+        self._reg = registry
+        self.sink = alert_sink or AlertSink(self.cfg.alert_queue_slots,
+                                            registry=registry)
+        self._batcher = MicroBatcher(
+            score_fn=self._score_fn, cfg=self.cfg, registry=registry,
+            on_scored=self._on_scored, on_failed=self._on_failed)
+        self._lock = threading.Lock()
+        self._streams: Dict[str, StreamHandle] = {}
+        self._warm = False
+        self._admission_open = False
+        self.warmup_seconds: Dict[str, float] = {}
+        # optional per-window SLO log: every scored window appends
+        # (stream, window_idx, latency_sec, late) — the registry histogram
+        # gives means, this gives exact percentiles (bench/SLO reporting)
+        self._window_log = window_log
+
+    # -- device program -------------------------------------------------------
+
+    def _score_fn(self, batch: Dict[str, np.ndarray]) -> np.ndarray:
+        """The shared device program: vmapped NerrfNet eval on one padded
+        batch → host node probabilities.  Same jit (make_eval_fn), same
+        host-side sigmoid as model_detect — the parity path."""
+        import jax
+
+        out = jax.device_get(self._eval_fn(self._params, batch))
+        return 1.0 / (1.0 + np.exp(-out["node_logit"]))
+
+    def _warmup(self, log=None) -> None:
+        """Compile the eval program for every configured bucket (the
+        detector-side warmup_detector sweep, through the serve path's own
+        shape authority so the jit cache is keyed exactly as admission will
+        key it).  Readiness (`ready`) gates on completion."""
+        tiny = _tiny_trace("serve-warmup")
+        for bucket in self.cfg.buckets:
+            ds_cfg = self.cfg.dataset_config(bucket)
+            samples = windows_of_trace(tiny, ds_cfg)
+            if not samples:
+                continue
+            s0 = samples[0]
+            batch = {k: np.broadcast_to(
+                v, (self.cfg.batch_size,) + v.shape).copy()
+                for k, v in s0.items()}
+            tag = bucket_tag(bucket)
+            t0 = time.perf_counter()
+            self._score_fn(batch)
+            self.warmup_seconds[tag] = round(time.perf_counter() - t0, 2)
+            self._batcher.mark_warm(bucket)
+            if log:
+                log(f"serve bucket {tag} warm "
+                    f"({self.warmup_seconds[tag]}s)")
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def start(self, log=None) -> "OnlineDetectionService":
+        if self.cfg.warmup_on_start:
+            self._warmup(log=log)
+        self._warm = True
+        self._batcher.start()
+        self._admission_open = True
+        return self
+
+    def ready(self) -> Tuple[bool, str]:
+        """Readiness (the /readyz contract): warmed AND admitting."""
+        if not self._warm:
+            return False, "warmup in progress"
+        if not self._admission_open:
+            return False, "admission closed"
+        return True, "ok"
+
+    def stop(self, drain: bool = True) -> None:
+        self._admission_open = False
+        self._batcher.stop(drain=drain)
+
+    # -- stream membership ----------------------------------------------------
+
+    def join(self, stream_id: str) -> StreamHandle:
+        if not self._admission_open:
+            raise RuntimeError("service is not admitting streams "
+                               "(call start(), or it is stopping)")
+        with self._lock:
+            if stream_id in self._streams:
+                raise ValueError(f"stream {stream_id!r} already joined")
+            handle = StreamHandle(stream_id, self.cfg)
+            self._streams[stream_id] = handle
+            self._reg.gauge_set(
+                "serve_streams_active", len(self._streams),
+                help="tracker streams currently admitted")
+        return handle
+
+    def feed(self, stream_id: str, events: EventArrays,
+             strings: StringTable) -> int:
+        """One decoded block in; returns the number of windows it closed
+        (each admitted to the micro-batcher)."""
+        handle = self._handle(stream_id)
+        if handle.closing:
+            raise RuntimeError(f"stream {stream_id!r} is leaving")
+        closed = handle.windower.feed(events, strings)
+        for idx, lo, hi in closed:
+            self._admit(handle, idx, lo, hi)
+        return len(closed)
+
+    def leave(self, stream_id: str, flush: bool = True,
+              timeout: float = 60.0) -> DetectionResult:
+        """Flush the stream's partial windows, wait for its in-flight
+        windows to score, and return the final DetectionResult (the
+        planner hand-off artifact).  Safe mid-batch: still-queued windows
+        are dropped in place; windows already assembled into a device batch
+        are awaited (bounded), and the batcher's deadline close guarantees
+        they fire without this stream feeding more."""
+        handle = self._handle(stream_id)
+        handle.closing = True
+        if flush:
+            for idx, lo, hi in handle.windower.flush():
+                self._admit(handle, idx, lo, hi)
+        deadline = time.monotonic() + timeout
+        with handle.cond:
+            # a stopped batcher scores nothing more — waiting the full
+            # timeout on its queue would just stall every leaving stream
+            while handle.live and self._batcher.running:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                handle.cond.wait(timeout=min(remaining, 0.25))
+            # still-queued leftovers (never assembled): drop cleanly
+            for idx in [i for i, r in handle.live.items()
+                        if self._batcher.mark_dropped(r)]:
+                del handle.live[idx]
+                handle.dropped += 1
+                self._reg.counter_inc(
+                    "serve_admission_dropped_total",
+                    labels={"reason": "leave"},
+                    help="windows dropped at the serve admission boundary")
+        det = self._finalize(handle)
+        with self._lock:
+            self._streams.pop(stream_id, None)
+            self._reg.gauge_set(
+                "serve_streams_active", len(self._streams),
+                help="tracker streams currently admitted")
+        self.sink.on_detection(stream_id, det)
+        return det
+
+    def connect(self, stream_id: str, target: str,
+                max_events: Optional[int] = None,
+                timeout: float = 30.0,
+                follow: bool = False,
+                reconnect_sec: float = 2.0) -> StreamRun:
+        """Drain a live Tracker endpoint as one stream (join → feed per
+        decoded block → leave at end-of-stream), on its own actor thread.
+
+        ``follow`` makes the actor RESIDENT (the serve pod's mode, same
+        contract as `nerrf ingest --follow`): when the wire stream ends —
+        clean end-of-replay or a gRPC deadline — the session finalizes
+        (DetectionResult in ``run.result``) and the actor reconnects as
+        ``<stream_id>#<n>``, forever, until the service stops admitting.
+        Without it a 'resident' deployment would exit at the first stream
+        end and crash-loop through the warmup sweep."""
+        from nerrf_tpu.ingest.service import TrackerClient
+
+        done = threading.Event()
+        run = StreamRun(stream=stream_id, thread=None, done=done)
+
+        def drain() -> None:
+            session = 0
+            try:
+                while True:
+                    sid = stream_id if session == 0 \
+                        else f"{stream_id}#{session}"
+                    joined = False
+                    try:
+                        self.join(sid)
+                        joined = True
+                        client = TrackerClient(target)
+                        for events, strings in client.iter_blocks(
+                                max_events=max_events, timeout=timeout):
+                            self.feed(sid, events, strings)
+                        run.result = self.leave(sid)
+                        run.error = None
+                    except BaseException as e:  # noqa: BLE001 — via run.error
+                        run.error = e
+                        # only tear down a stream THIS drain joined — when
+                        # join() itself failed (duplicate id), the live
+                        # stream under that id belongs to another actor
+                        if joined:
+                            try:
+                                run.result = self.leave(sid, timeout=5.0)
+                            except Exception:  # noqa: BLE001
+                                pass
+                    if not (follow and self._admission_open):
+                        return
+                    session += 1
+                    time.sleep(reconnect_sec)
+            finally:
+                done.set()
+
+        t = threading.Thread(target=drain, daemon=True,
+                             name=f"nerrf-serve-{stream_id}")
+        run.thread = t
+        t.start()
+        return run
+
+    # -- admission ------------------------------------------------------------
+
+    def _handle(self, stream_id: str) -> StreamHandle:
+        with self._lock:
+            try:
+                return self._streams[stream_id]
+            except KeyError:
+                raise KeyError(f"stream {stream_id!r} not joined") from None
+
+    def _admit(self, handle: StreamHandle, idx: int, lo: int, hi: int) -> None:
+        with trace_span("serve_admit", stream=handle.id, window=idx) as sp:
+            if not self._admission_open:
+                # the batcher is stopped/stopping: a window admitted now
+                # would queue forever and wedge this stream's leave()
+                handle.dropped += 1
+                self._reg.counter_inc(
+                    "serve_admission_dropped_total",
+                    labels={"reason": "closed"},
+                    help="windows dropped at the serve admission boundary")
+                return
+            # measure/lower from the window's slice of the stream, not the
+            # whole accumulated history — O(window) admission, not
+            # O(stream) (bit-identical: same events selected either way)
+            ev = handle.windower.window_view(lo, hi)
+            n, e = measure_window(ev, lo, hi)
+            sel = ev.valid & (ev.ts_ns >= lo) & (ev.ts_ns < hi)
+            files = len(np.unique(ev.inode[sel & (ev.inode > 0)]))
+            sp.args.update(nodes=n, edges=e, files=files)
+            bucket = select_bucket(n, e, files, self.cfg.buckets)
+            if bucket is None:
+                handle.rejected += 1
+                self._reg.counter_inc(
+                    "serve_admission_dropped_total",
+                    labels={"reason": "oversize"},
+                    help="windows dropped at the serve admission boundary")
+                return
+            sp.args["bucket"] = bucket_tag(bucket)
+            sample, _stats = window_sample(
+                Trace(events=ev, strings=handle.windower.strings,
+                      ground_truth=None, labels=None, name=handle.id),
+                lo, hi, self.cfg.dataset_config(bucket))
+            if sample is None:
+                handle.skipped += 1
+                self._reg.counter_inc(
+                    "serve_windows_skipped_total",
+                    help="windows below min_events (no signal, not scored)")
+                return
+            now = time.perf_counter()
+            req = WindowRequest(
+                stream=handle.id, window_idx=idx, lo_ns=lo, hi_ns=hi,
+                bucket=bucket, sample=sample, t_admit=now,
+                deadline=now + self.cfg.window_deadline_sec)
+            with handle.cond:
+                if len(handle.live) >= self.cfg.stream_queue_slots:
+                    # drop-OLDEST: under sustained overload the newest
+                    # evidence wins (the oldest window is the least
+                    # actionable); only still-queued requests are droppable
+                    for old_idx, old in handle.live.items():
+                        if self._batcher.mark_dropped(old):
+                            del handle.live[old_idx]
+                            handle.dropped += 1
+                            self._reg.counter_inc(
+                                "serve_admission_dropped_total",
+                                labels={"reason": "backpressure"},
+                                help="windows dropped at the serve "
+                                     "admission boundary")
+                            break
+                handle.live[idx] = req
+                handle.admitted += 1
+            self._reg.counter_inc(
+                "serve_windows_admitted_total",
+                help="windows admitted into the micro-batcher")
+            self._batcher.submit(req)
+
+    # -- demux ----------------------------------------------------------------
+
+    def _on_scored(self, scored: List[ScoredWindow]) -> None:
+        alert_thr = (self.cfg.threshold if self.cfg.threshold is not None
+                     else 0.5)
+        for s in scored:
+            if self._window_log is not None:
+                self._window_log.append(
+                    (s.stream, s.window_idx, s.t_scored - s.t_admit, s.late))
+            with self._lock:
+                handle = self._streams.get(s.stream)
+            if handle is not None:
+                with handle.cond:
+                    handle.live.pop(s.window_idx, None)
+                    handle.scored.append(s)
+                    handle.cond.notify_all()
+            # alerting: hot windows only, never blocking (bounded sink)
+            mask = s.node_mask.astype(bool)
+            if not mask.any():
+                continue
+            hot_slots = np.nonzero(mask & (s.probs >= alert_thr))[0]
+            if not len(hot_slots):
+                continue
+            order = np.argsort(-s.probs[hot_slots], kind="stable")
+            hot = [("file" if s.node_type[i] == NODE_TYPE_FILE else "proc",
+                    int(s.node_key[i]), float(s.probs[i]))
+                   for i in hot_slots[order][:16]]
+            self.sink.emit(WindowAlert(
+                stream=s.stream, window_idx=s.window_idx,
+                lo_ns=s.lo_ns, hi_ns=s.hi_ns,
+                max_prob=float(s.probs[mask].max()), hot=hot,
+                t_admit=s.t_admit, t_scored=s.t_scored, late=s.late))
+
+    def _on_failed(self, reqs: List[WindowRequest], exc: BaseException) -> None:
+        for r in reqs:
+            with self._lock:
+                handle = self._streams.get(r.stream)
+            if handle is None:
+                continue
+            with handle.cond:
+                handle.live.pop(r.window_idx, None)
+                handle.failed += 1
+                handle.cond.notify_all()
+            self._reg.counter_inc(
+                "serve_windows_failed_total",
+                help="windows lost to a failed device batch")
+
+    # -- finalize -------------------------------------------------------------
+
+    def _finalize(self, handle: StreamHandle) -> DetectionResult:
+        if handle.windower.strings is None:  # stream never produced events
+            return DetectionResult({}, {}, {},
+                                   detector=f"serve[{self.cfg.agg}]")
+        trace = handle.windower.trace(name=handle.id)
+        ino_path = _inode_to_path(trace)
+        pid_comm = _pid_to_comm(trace)
+        window_scores: Dict[str, list] = {}
+        proc_scores: Dict[str, float] = {}
+        # window order, exactly like model_detect's batch loop — keeps the
+        # per-path window-score lists bit-identical
+        for s in sorted(handle.scored, key=lambda sw: sw.window_idx):
+            accumulate_node_scores(s.probs, s.node_type, s.node_key,
+                                   s.node_mask, ino_path, pid_comm,
+                                   window_scores, proc_scores)
+        return finalize_detection(trace, window_scores, proc_scores,
+                                  agg=self.cfg.agg,
+                                  threshold=self.cfg.threshold,
+                                  detector=f"serve[{self.cfg.agg}]",
+                                  ino_path=ino_path)
+
+
+def _tiny_trace(name: str) -> Trace:
+    """The shape-donor trace for warmup/init: any tiny unlabeled trace
+    yields a window sample, only the SHAPES matter.  One synthesis recipe —
+    warmup and param init must agree on it or their sample shapes drift."""
+    from nerrf_tpu.data.synth import SimConfig, simulate_trace
+
+    tiny = simulate_trace(SimConfig(duration_sec=20.0, attack=False,
+                                    num_target_files=2, benign_rate_hz=4.0,
+                                    seed=1))
+    return Trace(events=tiny.events, strings=tiny.strings,
+                 ground_truth=None, labels=None, name=name)
+
+
+def init_untrained_params(model: NerrfNet, cfg: ServeConfig, seed: int = 0):
+    """Randomly initialized params at the service's smallest bucket shape —
+    for load testing and smoke runs without a trained checkpoint (the model
+    is shape-polymorphic, so any bucket's sample initializes it)."""
+    import jax
+
+    from nerrf_tpu.train.loop import model_inputs
+
+    ds_cfg = cfg.dataset_config(sorted(cfg.buckets)[0])
+    samples = windows_of_trace(_tiny_trace("init"), ds_cfg)
+    if not samples:
+        raise RuntimeError("could not synthesize an init sample")
+    one = {k: np.asarray(v) for k, v in samples[0].items()}
+    return model.init(jax.random.PRNGKey(seed), *model_inputs(one),
+                      deterministic=True)["params"]

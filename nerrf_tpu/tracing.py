@@ -35,6 +35,10 @@ Span naming scheme (dot-separated, coarse → fine):
     eval               held-out evaluation pass
     checkpoint         full-state checkpoint save
     mcts_plan          one planner search; mcts_leaf_eval = device batch
+    serve_admit        one stream window measured/lowered/enqueued (serve)
+    serve_batch_close  a bucket's shared batch assembled (occupancy/deadline)
+    serve_device_score one shared padded batch through the eval program
+    serve_demux        scored batch fanned back to streams + alert sink
 
 The ring buffer records unconditionally (bounded memory, ~µs overhead);
 ``DEFAULT_TRACER.enabled`` additionally opts hot loops into per-step

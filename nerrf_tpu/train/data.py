@@ -77,6 +77,42 @@ def _seq_node_index(g: GraphBatch, seqs: SequenceBatch) -> np.ndarray:
     return out
 
 
+def window_sample(trace: Trace, lo: int, hi: int, cfg: DatasetConfig,
+                  labels: Optional[np.ndarray] = None):
+    """Lower ONE window [lo, hi) to a padded sample → ``(sample, stats)``.
+
+    ``sample`` is None when the window carries fewer than ``cfg.min_events``
+    events (all padding, no signal).  This is THE per-window lowering, shared
+    by the offline dataset path (`windows_of_trace`) and the online serving
+    windower (`nerrf_tpu.serve.windower`) — splitting it would let the two
+    paths drift and break the serve path's bit-parity with `model_detect`.
+    """
+    g, stats = build_window_graph(trace.events, trace.strings, lo, hi,
+                                  cfg.graph, labels=labels)
+    if stats.num_events < cfg.min_events:
+        return None, stats
+    seqs = build_file_sequences(trace, labels=labels, seq_len=cfg.seq_len,
+                                lo_ns=lo, hi_ns=hi)
+    if len(seqs) > cfg.max_seqs:
+        # keep the most event-dense sequences (they carry the signal)
+        density = seqs.mask.sum(axis=1)
+        keep = np.argsort(-density, kind="stable")[: cfg.max_seqs]
+        keep.sort()
+        seqs = SequenceBatch(feat=seqs.feat[keep], mask=seqs.mask[keep],
+                             label=seqs.label[keep], inode=seqs.inode[keep])
+    seqs = seqs.pad_to(cfg.max_seqs)
+    seq_valid = seqs.mask.any(axis=1)
+    sample = dict(g.arrays())
+    sample.update(
+        seq_feat=seqs.feat.astype(np.float32),
+        seq_mask=seqs.mask,
+        seq_label=seqs.label.astype(np.float32),
+        seq_valid=seq_valid,
+        seq_node_idx=_seq_node_index(g, seqs),
+    )
+    return sample, stats
+
+
 def windows_of_trace(trace: Trace, cfg: DatasetConfig,
                      stats_out: Optional[list] = None) -> List[dict[str, np.ndarray]]:
     """All window samples for one trace.
@@ -93,30 +129,11 @@ def windows_of_trace(trace: Trace, cfg: DatasetConfig,
     valid_ts = ev.ts_ns[ev.valid]
     out = []
     for lo, hi in snapshot_windows(int(valid_ts.min()), int(valid_ts.max()), cfg.graph):
-        g, stats = build_window_graph(ev, trace.strings, lo, hi, cfg.graph, labels=labels)
-        if stats.num_events < cfg.min_events:
+        sample, stats = window_sample(trace, lo, hi, cfg, labels=labels)
+        if sample is None:
             continue
         if stats_out is not None:
             stats_out.append(stats)
-        seqs = build_file_sequences(trace, labels=labels, seq_len=cfg.seq_len,
-                                    lo_ns=lo, hi_ns=hi)
-        if len(seqs) > cfg.max_seqs:
-            # keep the most event-dense sequences (they carry the signal)
-            density = seqs.mask.sum(axis=1)
-            keep = np.argsort(-density, kind="stable")[: cfg.max_seqs]
-            keep.sort()
-            seqs = SequenceBatch(feat=seqs.feat[keep], mask=seqs.mask[keep],
-                                 label=seqs.label[keep], inode=seqs.inode[keep])
-        seqs = seqs.pad_to(cfg.max_seqs)
-        seq_valid = seqs.mask.any(axis=1)
-        sample = dict(g.arrays())
-        sample.update(
-            seq_feat=seqs.feat.astype(np.float32),
-            seq_mask=seqs.mask,
-            seq_label=seqs.label.astype(np.float32),
-            seq_valid=seq_valid,
-            seq_node_idx=_seq_node_index(g, seqs),
-        )
         out.append(sample)
     return out
 

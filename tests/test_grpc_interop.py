@@ -219,6 +219,90 @@ def test_reflection_file_by_filename_and_not_found(synthetic_daemon):
     assert 7 in missing, f"expected error_response, got {missing}"
 
 
+# ---- reflection on the Python replay server ---------------------------------
+# The same grpcurl list/describe flows against TraceReplayServer (the
+# reference daemon's replay flavor), served from the descriptor bytes
+# already checked in as trace_pb2 — no grpcio-reflection dependency.
+
+
+@pytest.fixture(scope="module")
+def replay_server():
+    from nerrf_tpu.data import SimConfig, simulate_trace
+    from nerrf_tpu.ingest.service import TraceReplayServer
+
+    tr = simulate_trace(SimConfig(duration_sec=10.0, attack=False,
+                                  num_target_files=2, benign_rate_hz=4.0,
+                                  seed=1))
+    server = TraceReplayServer(tr.events, tr.strings)
+    port = server.start()
+    yield port
+    server.stop()
+
+
+def test_replay_server_reflection_list_services(replay_server):
+    resp = _reflect(replay_server, _ld(7, b""))
+    assert 6 in resp, f"no list_services_response arm in {resp}"
+    names = [dict(_fields(svc))[1].decode()
+             for f, svc in _fields(resp[6]) if f == 1]
+    assert "nerrf.trace.Tracker" in names
+    # both reflection flavors are themselves listed (grpcurl shows them)
+    assert "grpc.reflection.v1alpha.ServerReflection" in names
+
+
+def test_replay_server_reflection_file_containing_symbol(replay_server):
+    from google.protobuf import descriptor_pb2
+
+    resp = _reflect(replay_server, _ld(4, b"nerrf.trace.Tracker"))
+    assert 4 in resp, f"no file_descriptor_response arm in {resp}"
+    files = {}
+    for f, fd_bytes in _fields(resp[4]):
+        if f == 1:
+            fdp = descriptor_pb2.FileDescriptorProto()
+            fdp.ParseFromString(fd_bytes)
+            files[fdp.name] = fdp
+    assert "trace.proto" in files
+    trace = files["trace.proto"]
+    assert trace.package == "nerrf.trace"
+    assert [s.name for s in trace.service] == ["Tracker"]
+    assert [m.name for m in trace.service[0].method] == ["StreamEvents"]
+    # transitive deps travel with the file (grpcurl needs timestamp.proto
+    # to resolve Event.ts)
+    assert "google/protobuf/timestamp.proto" in files
+
+
+def test_replay_server_reflection_v1_and_errors(replay_server):
+    from google.protobuf import descriptor_pb2
+
+    # the newer v1 service name answers identically (modern grpcurl tries
+    # it first)
+    with grpc.insecure_channel(f"127.0.0.1:{replay_server}") as channel:
+        call = channel.stream_stream(
+            "/grpc.reflection.v1.ServerReflection/ServerReflectionInfo",
+            request_serializer=lambda b: b,
+            response_deserializer=lambda b: b,
+        )(iter([_ld(3, b"trace.proto")]), timeout=15.0)
+        resp = dict(_fields(next(iter(call))))
+    assert 4 in resp
+    fdp = descriptor_pb2.FileDescriptorProto()
+    fdp.ParseFromString(next(b for f, b in _fields(resp[4]) if f == 1))
+    assert {m.name for m in fdp.message_type} >= {"Event", "EventBatch",
+                                                  "Empty"}
+    missing = _reflect(replay_server, _ld(4, b"no.such.Symbol"))
+    assert 7 in missing, f"expected error_response, got {missing}"
+
+
+def test_replay_server_reflection_streams_coexist(replay_server):
+    """Reflection must not disturb the event stream: both RPCs on one
+    server, one after the other."""
+    from nerrf_tpu.ingest.service import TrackerClient
+
+    resp = _reflect(replay_server, _ld(7, b""))
+    assert 6 in resp
+    events, _ = TrackerClient(f"127.0.0.1:{replay_server}").stream(
+        max_events=50, timeout=30.0)
+    assert events.num_valid > 0
+
+
 def test_replay_mode_delivers_trace_with_parity(tmp_path):
     """--replay streams a real incident trace through the daemon: every
     event must arrive through stock grpcio, with syscalls/paths intact and

@@ -2,6 +2,7 @@
 
 import json
 import threading
+import urllib.error
 import urllib.request
 import warnings
 
@@ -127,6 +128,51 @@ def test_metrics_server_serves_scrape_and_health():
         health = json.loads(urllib.request.urlopen(
             f"http://127.0.0.1:{srv.port}/healthz", timeout=5).read())
         assert health["status"] == "ok"
+        # no ready_check: ready as soon as live
+        ready = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/readyz", timeout=5).read())
+        assert ready["status"] == "ready"
+
+
+def test_readyz_tracks_ready_check_liveness_does_not():
+    """/healthz = liveness (always ok while serving); /readyz = readiness,
+    503 while the subsystem behind the server is booting/draining — the
+    k8s-probe split that distinguishes 'booting' from 'broken'."""
+    state = {"ready": False, "reason": "warmup in progress"}
+    with MetricsServer(registry=MetricsRegistry(),
+                       port=0,
+                       ready_check=lambda: (state["ready"],
+                                            state["reason"])) as srv:
+        # booting: live but unready
+        health = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/healthz", timeout=5).read())
+        assert health["status"] == "ok"
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/readyz", timeout=5)
+        assert exc.value.code == 503
+        body = json.loads(exc.value.read())
+        assert body == {"status": "unready",
+                        "reason": "warmup in progress",
+                        "uptime_sec": body["uptime_sec"]}
+        # warm: readiness flips without a restart
+        state.update(ready=True, reason="ok")
+        ready = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/readyz", timeout=5).read())
+        assert ready["status"] == "ready"
+
+
+def test_readyz_broken_check_fails_closed():
+    def boom():
+        raise RuntimeError("probe exploded")
+
+    with MetricsServer(registry=MetricsRegistry(), port=0,
+                       ready_check=boom) as srv:
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/readyz", timeout=5)
+        assert exc.value.code == 503
+        assert "probe exploded" in json.loads(exc.value.read())["reason"]
 
 
 def test_pipeline_components_report_to_default_registry(tmp_path):

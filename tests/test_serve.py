@@ -1,0 +1,493 @@
+"""Online detection service: cross-stream micro-batching, backpressure,
+isolation, and bit-parity with the offline model_detect path.
+
+The batching/backpressure tests run with a FAKE score function (the
+micro-batcher is model-free by design), so the scheduling logic is covered
+without compiling anything; one test at the end compiles the real small
+model and asserts the bit-parity acceptance criterion.
+"""
+
+import dataclasses
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from nerrf_tpu.data.loaders import Trace
+from nerrf_tpu.data.synth import SimConfig, simulate_trace
+from nerrf_tpu.observability import MetricsRegistry
+from nerrf_tpu.serve import (
+    MicroBatcher,
+    OnlineDetectionService,
+    ServeConfig,
+    StreamWindower,
+    WindowRequest,
+    select_bucket,
+)
+
+BUCKET_A = (128, 256, 32)
+BUCKET_B = (256, 512, 64)
+
+
+def _blocks(trace, size=200):
+    ev = trace.events
+    for i in range(0, len(ev), size):
+        yield type(ev)(**{f.name: getattr(ev, f.name)[i:i + size]
+                          for f in dataclasses.fields(ev)})
+
+
+def _sim(seed=3, duration=60.0, attack=True, files=6, rate=6.0):
+    return simulate_trace(SimConfig(duration_sec=duration, attack=attack,
+                                    attack_start_sec=duration / 3,
+                                    num_target_files=files,
+                                    benign_rate_hz=rate, seed=seed))
+
+
+def _fake_service(cfg, registry=None, score=None, start=True):
+    """A service whose device program is a stub: covers windowing,
+    admission, packing and demux without any compile."""
+    registry = registry or MetricsRegistry(namespace="test")
+    svc = OnlineDetectionService.__new__(OnlineDetectionService)
+    # minimal init without model/eval (the batcher only needs score_fn)
+    svc.cfg = cfg
+    svc._params = None
+    svc._model = None
+    svc._reg = registry
+    from nerrf_tpu.serve.alerts import AlertSink
+
+    svc.sink = AlertSink(cfg.alert_queue_slots, registry=registry)
+    score = score or (lambda batch:
+                      np.full(batch["node_mask"].shape, 0.9, np.float64))
+    svc._batcher = MicroBatcher(score_fn=score, cfg=cfg, registry=registry,
+                                on_scored=svc._on_scored,
+                                on_failed=svc._on_failed)
+    svc._lock = threading.Lock()
+    svc._streams = {}
+    svc._warm = True
+    svc._admission_open = False
+    svc.warmup_seconds = {}
+    svc._window_log = None
+    for b in cfg.buckets:
+        svc._batcher.mark_warm(b)
+    if start:
+        svc._batcher.start()
+        svc._admission_open = True
+    return svc, registry
+
+
+# -- bucket selection ---------------------------------------------------------
+
+def test_select_bucket_first_fit_and_soft_seq_overflow():
+    ladder = (BUCKET_A, BUCKET_B, (1024, 2048, 128))
+    assert select_bucket(100, 200, 10, ladder) == BUCKET_A
+    assert select_bucket(200, 200, 10, ladder) == BUCKET_B
+    # sequence overflow is soft: stay on the smallest graph-fitting rung
+    # (padding is compute) and truncate to the densest max_seqs, exactly
+    # like the offline path at a fixed DatasetConfig
+    assert select_bucket(100, 200, 500, ladder) == BUCKET_A
+    # ...but within that rung, the bucket with the most seq slots wins
+    assert select_bucket(
+        200, 200, 500,
+        ((256, 512, 64), (256, 512, 128), (1024, 2048, 256))) \
+        == (256, 512, 128)
+    assert select_bucket(999, 1000, 10, ladder) == (1024, 2048, 128)
+    # node/edge overflow is hard: nothing fits → None (reject, never drop
+    # events silently)
+    assert select_bucket(5000, 10, 10, ladder) is None
+
+
+# -- windower: streaming == offline boundaries --------------------------------
+
+def test_windower_matches_snapshot_windows():
+    from nerrf_tpu.graph import GraphConfig
+    from nerrf_tpu.graph.builder import snapshot_windows
+
+    tr = _sim(seed=11, duration=80.0)
+    w = StreamWindower(window_sec=15.0, stride_sec=5.0)
+    closed = []
+    for block in _blocks(tr, size=137):
+        closed += w.feed(block, tr.strings)
+    closed += w.flush()
+    ts = tr.events.ts_ns[tr.events.valid]
+    expect = list(snapshot_windows(
+        int(ts.min()), int(ts.max()),
+        GraphConfig(window_sec=15.0, stride_sec=5.0)))
+    assert [(lo, hi) for _, lo, hi in closed] == expect
+    assert [i for i, _, _ in closed] == list(range(len(expect)))
+    assert w.late_events == 0
+    # the accumulated trace is the whole stream
+    assert w.events.num_valid == tr.events.num_valid
+
+
+def test_windower_window_view_slices_ordered_streams():
+    """Admission lowers from an O(log n) slice on in-order streams; the
+    slice selects exactly the window's events.  Out-of-order delivery
+    falls back to the full array (correct, just slower)."""
+    tr = _sim(seed=31, duration=60.0)
+    w = StreamWindower(window_sec=15.0, stride_sec=5.0)
+    closed = []
+    for block in _blocks(tr, size=100):
+        closed += w.feed(block, tr.strings)
+    closed += w.flush()
+    assert closed
+    _, lo, hi = closed[len(closed) // 2]
+    view = w.window_view(lo, hi)
+    full = w.events
+    in_window = full.valid & (full.ts_ns >= lo) & (full.ts_ns < hi)
+    assert len(view) == int(in_window.sum())
+    assert (view.ts_ns == full.ts_ns[in_window]).all()
+
+    # out-of-order feed → fallback to the whole array
+    w2 = StreamWindower(window_sec=15.0, stride_sec=5.0)
+    blocks = list(_blocks(tr, size=150))
+    w2.feed(blocks[1], tr.strings)
+    w2.feed(blocks[0], tr.strings)  # older events after newer: late
+    assert w2.late_events > 0
+    assert len(w2.window_view(lo, hi)) == len(w2.events)
+
+
+def test_admission_closed_after_stop_drops_counted():
+    """stop() hard-closes admission: a still-attached stream's windows are
+    dropped with a distinct reason instead of queueing into the stopped
+    batcher and wedging leave() for its full timeout."""
+    cfg = ServeConfig(buckets=(BUCKET_B,), batch_size=4,
+                      batch_close_sec=0.02, window_sec=10.0, stride_sec=5.0)
+    svc, reg = _fake_service(cfg)
+    svc.join("s0")
+    tr = _sim(seed=37, duration=60.0, files=4, rate=6.0)
+    blocks = list(_blocks(tr, size=250))
+    svc.feed("s0", blocks[0], tr.strings)
+    svc.stop(drain=True)
+    for b in blocks[1:]:
+        svc.feed("s0", b, tr.strings)  # post-stop: drop, don't queue
+    assert reg.value("serve_admission_dropped_total",
+                     labels={"reason": "closed"}) > 0
+    t0 = time.perf_counter()
+    det = svc.leave("s0", timeout=30.0)  # must NOT wait the 30 s
+    assert time.perf_counter() - t0 < 5.0
+    assert det.detector == "serve[max]"
+
+
+def test_connect_follow_reconnects_sessions():
+    """follow=True: the actor finalizes each wire session and reconnects
+    (the resident serve-pod contract) until the service stops."""
+    from nerrf_tpu.ingest.service import TraceReplayServer
+
+    cfg = ServeConfig(buckets=(BUCKET_B,), batch_size=4,
+                      batch_close_sec=0.02, window_sec=10.0, stride_sec=5.0)
+    svc, reg = _fake_service(cfg)
+    tr = _sim(seed=41, duration=40.0, files=3, rate=5.0)
+    server = TraceReplayServer(tr.events, tr.strings, batch_size=256)
+    port = server.start()
+    try:
+        run = svc.connect("s0", f"127.0.0.1:{port}", timeout=30.0,
+                          follow=True, reconnect_sec=0.05)
+        deadline = time.perf_counter() + 30.0
+        while len(svc.sink.detections) < 2 and time.perf_counter() < deadline:
+            time.sleep(0.05)
+        # at least two sessions finalized: s0 and its reconnect s0#1
+        assert {"s0", "s0#1"} <= set(svc.sink.detections)
+        svc.stop(drain=False)
+        assert run.done.wait(timeout=30.0)  # actor exits once admission closes
+    finally:
+        server.stop()
+        svc.stop(drain=False)
+
+
+# -- micro-batcher: deterministic packing across buckets ----------------------
+
+def test_batcher_packs_same_bucket_cross_stream_deterministically():
+    cfg = ServeConfig(buckets=(BUCKET_A, BUCKET_B), batch_size=4,
+                      batch_close_sec=10.0)  # close only on occupancy here
+    seen = []
+
+    def score(batch):
+        seen.append({k: v.copy() for k, v in batch.items()})
+        return np.zeros(batch["node_mask"].shape)
+
+    reg = MetricsRegistry(namespace="test")
+    got = []
+    mb = MicroBatcher(score_fn=score, cfg=cfg, registry=reg,
+                      on_scored=got.extend)
+    mb.mark_warm(BUCKET_A), mb.mark_warm(BUCKET_B)
+
+    def req(stream, idx, bucket):
+        sample = {"node_mask": np.zeros(bucket[0], np.bool_),
+                  "node_type": np.zeros(bucket[0], np.int32),
+                  "node_key": np.zeros(bucket[0], np.int64)}
+        now = time.perf_counter()
+        return WindowRequest(stream=stream, window_idx=idx, lo_ns=0, hi_ns=1,
+                             bucket=bucket, sample=sample, t_admit=now,
+                             deadline=now + 10)
+
+    # interleaved submission from two streams into two buckets
+    order = [("s0", 0, BUCKET_A), ("s1", 0, BUCKET_B), ("s0", 1, BUCKET_B),
+             ("s1", 1, BUCKET_A), ("s0", 2, BUCKET_A), ("s1", 2, BUCKET_B),
+             ("s1", 3, BUCKET_A), ("s0", 3, BUCKET_B)]
+    for stream, idx, bucket in order:
+        mb.submit(req(stream, idx, bucket))
+    # both buckets reached occupancy 4 → exactly two batches, FIFO packed
+    assert mb.drain_once() == 2
+    assert len(got) == 8
+    by_batch = {}
+    for s in got:
+        by_batch.setdefault(tuple(s.bucket), []).append((s.stream, s.window_idx))
+    assert by_batch[BUCKET_A] == [("s0", 0), ("s1", 1), ("s0", 2), ("s1", 3)]
+    assert by_batch[BUCKET_B] == [("s1", 0), ("s0", 1), ("s1", 2), ("s0", 3)]
+    # occupancy metric saw 4-window batches, close cause = occupancy
+    assert reg.value("serve_batch_occupancy",
+                     labels={"bucket": "128n/256e/32s"}, stat="mean") == 4.0
+    assert reg.value("serve_batches_total",
+                     labels={"bucket": "128n/256e/32s",
+                             "cause": "occupancy"}) == 1
+
+
+# -- slow-consumer isolation --------------------------------------------------
+
+def test_stalled_stream_cannot_delay_another_buckets_batch_close():
+    """Stream A stalls after half a window; stream B's windows must close
+    on the deadline and score without A ever completing anything."""
+    cfg = ServeConfig(buckets=(BUCKET_A, BUCKET_B), batch_size=8,
+                      batch_close_sec=0.05, window_sec=15.0, stride_sec=5.0)
+    svc, reg = _fake_service(cfg)
+    try:
+        svc.join("stalled")
+        svc.join("live")
+        tr = _sim(seed=5, duration=45.0, files=3, rate=4.0)
+        blocks = list(_blocks(tr, size=150))
+        # the stalled stream feeds ONE block (never enough to close a
+        # window) and then goes silent
+        svc.feed("stalled", blocks[0], tr.strings)
+        t0 = time.perf_counter()
+        for b in blocks:
+            svc.feed("live", b, tr.strings)
+        det = svc.leave("live", timeout=10.0)
+        waited = time.perf_counter() - t0
+        assert det.detector == "serve[max]"
+        h = svc._streams.get("live")
+        assert h is None  # clean leave
+        assert reg.value("serve_windows_scored_total") >= 1
+        # deadline close fired well under the stalled stream's "never"
+        assert waited < 5.0
+        causes = [c for c in ("deadline", "occupancy", "flush")
+                  if reg.value("serve_batches_total",
+                               labels={"bucket": "128n/256e/32s",
+                                       "cause": c})
+                  or reg.value("serve_batches_total",
+                               labels={"bucket": "256n/512e/64s",
+                                       "cause": c})]
+        assert causes, "no batch ever closed"
+    finally:
+        svc.stop(drain=False)
+
+
+# -- drop-oldest under sustained overload -------------------------------------
+
+def test_drop_oldest_under_sustained_overload():
+    """With scoring wedged, a 2-slot stream queue must keep only the two
+    NEWEST windows and count every eviction."""
+    gate = threading.Event()
+
+    def slow_score(batch):
+        gate.wait(timeout=30.0)
+        return np.zeros(batch["node_mask"].shape)
+
+    cfg = ServeConfig(buckets=(BUCKET_B,), batch_size=8,
+                      batch_close_sec=10.0,  # nothing closes during the test
+                      stream_queue_slots=2,
+                      window_sec=10.0, stride_sec=5.0)
+    svc, reg = _fake_service(cfg, score=slow_score)
+    try:
+        svc.join("s0")
+        tr = _sim(seed=9, duration=120.0, files=4, rate=6.0)
+        for b in _blocks(tr, size=400):
+            svc.feed("s0", b, tr.strings)
+        h = svc._streams["s0"]
+        assert h.admitted > 4
+        assert h.dropped == h.admitted - 2          # all but the newest two
+        assert len(h.live) == 2
+        # drop-OLDEST: the survivors are exactly the two NEWEST windows
+        assert sorted(h.live) == [h.windower.windows_emitted - 2,
+                                  h.windower.windows_emitted - 1]
+        assert reg.value("serve_admission_dropped_total",
+                         labels={"reason": "backpressure"}) == h.dropped
+    finally:
+        gate.set()
+        svc.stop(drain=False)
+
+
+# -- stream leave mid-batch ---------------------------------------------------
+
+def test_stream_leave_mid_batch_is_clean_and_isolated():
+    """Leaving while windows sit queued (scoring wedged) must drop them
+    cleanly, return a result from whatever DID score, and leave the other
+    stream fully functional."""
+    release = threading.Event()
+    calls = []
+
+    def gated_score(batch):
+        calls.append(1)
+        if len(calls) > 1:
+            release.wait(timeout=5.0)
+        return np.full(batch["node_mask"].shape, 0.9)
+
+    cfg = ServeConfig(buckets=(BUCKET_B,), batch_size=2,
+                      batch_close_sec=0.02, window_sec=10.0, stride_sec=5.0)
+    svc, reg = _fake_service(cfg, score=gated_score)
+    try:
+        svc.join("leaver")
+        svc.join("stayer")
+        tr = _sim(seed=13, duration=60.0, files=4, rate=6.0)
+        for b in _blocks(tr, size=300):
+            svc.feed("leaver", b, tr.strings)
+        time.sleep(0.2)  # first batch through, second wedged in gated_score
+        det = svc.leave("leaver", timeout=0.5)
+        assert det.detector == "serve[max]"
+        assert "leaver" not in svc._streams
+        dropped_on_leave = reg.value("serve_admission_dropped_total",
+                                     labels={"reason": "leave"})
+        release.set()
+        # the other stream still works end to end afterwards
+        for b in _blocks(tr, size=300):
+            svc.feed("stayer", b, tr.strings)
+        det2 = svc.leave("stayer", timeout=10.0)
+        assert len(det2.file_window_scores) > 0
+        # ledger accounting is exact: nothing leaked
+        assert dropped_on_leave >= 0
+    finally:
+        release.set()
+        svc.stop(drain=False)
+
+
+# -- alerts + demux overflow --------------------------------------------------
+
+def test_alert_sink_bounded_overflow_counted():
+    cfg = ServeConfig(buckets=(BUCKET_B,), batch_size=4,
+                      batch_close_sec=0.02, window_sec=10.0, stride_sec=5.0,
+                      alert_queue_slots=2)
+    svc, reg = _fake_service(cfg)  # fake score: every window is hot (0.9)
+    try:
+        svc.join("s0")
+        tr = _sim(seed=17, duration=80.0, files=4, rate=6.0)
+        for b in _blocks(tr, size=300):
+            svc.feed("s0", b, tr.strings)
+        svc.leave("s0", timeout=10.0)
+        scored = reg.value("serve_windows_scored_total")
+        assert scored > 2
+        assert len(svc.sink) == 2  # bounded: only the newest alerts kept
+        assert reg.value("serve_demux_overflows_total") == scored - 2
+        a = svc.sink.drain()[-1]
+        assert a.max_prob == pytest.approx(0.9)
+        assert a.hot and a.hot[0][0] in ("file", "proc")
+    finally:
+        svc.stop(drain=False)
+
+
+# -- oversize rejection -------------------------------------------------------
+
+def test_oversize_window_rejected_not_resized():
+    cfg = ServeConfig(buckets=((16, 16, 8),), batch_size=2,
+                      batch_close_sec=0.02, window_sec=30.0, stride_sec=15.0)
+    svc, reg = _fake_service(cfg)
+    try:
+        svc.join("s0")
+        tr = _sim(seed=19, duration=90.0, files=8, rate=10.0)
+        for b in _blocks(tr, size=400):
+            svc.feed("s0", b, tr.strings)
+        svc.leave("s0", timeout=5.0)
+        assert reg.value("serve_admission_dropped_total",
+                         labels={"reason": "oversize"}) > 0
+        # nothing was compiled/scored at an unconfigured shape
+        assert reg.value("serve_recompiles_total",
+                         labels={"bucket": "16n/16e/8s"}) == 0
+    finally:
+        svc.stop(drain=False)
+
+
+# -- the acceptance criterion: bit-parity with offline model_detect ----------
+
+@pytest.fixture(scope="module")
+def small_model():
+    import jax
+
+    from nerrf_tpu.models import JointConfig, NerrfNet
+    from nerrf_tpu.serve import init_untrained_params
+
+    cfg = ServeConfig(buckets=(BUCKET_B,), batch_size=4,
+                      window_sec=15.0, stride_sec=5.0)
+    model = NerrfNet(JointConfig().small)
+    params = init_untrained_params(model, cfg)
+    del jax
+    return model, params, cfg
+
+
+def test_single_stream_bit_parity_with_model_detect(small_model):
+    from nerrf_tpu.pipeline import model_detect
+
+    model, params, cfg = small_model
+    svc = OnlineDetectionService(params, model, cfg=cfg,
+                                 registry=MetricsRegistry(namespace="test"))
+    svc.start()
+    try:
+        tr = _sim(seed=3, duration=60.0)
+        svc.join("s0")
+        for b in _blocks(tr, size=200):
+            svc.feed("s0", b, tr.strings)
+        det = svc.leave("s0", timeout=60.0)
+    finally:
+        svc.stop()
+    offline = model_detect(
+        Trace(events=tr.events, strings=tr.strings, ground_truth=None,
+              labels=None, name="s0"),
+        params, model, ds_cfg=cfg.dataset_config(BUCKET_B),
+        auto_capacity=False, batch_size=cfg.batch_size)
+    # bit-identical: same floats, same dicts, same threshold
+    assert det.file_scores == offline.file_scores
+    assert det.file_window_scores == offline.file_window_scores
+    assert det.proc_scores == offline.proc_scores
+    assert det.file_bytes == offline.file_bytes
+    assert det.threshold == offline.threshold
+    assert det.detector == "serve[max]"
+
+
+def test_two_streams_share_batches_with_parity(small_model):
+    """Windows of two concurrent streams pack into shared batches (measured
+    occupancy > 1 at the bucket) and each stream's result still matches its
+    own offline detection exactly."""
+    from nerrf_tpu.pipeline import model_detect
+
+    model, params, cfg = small_model
+    cfg = dataclasses.replace(cfg, batch_close_sec=0.25)
+    reg = MetricsRegistry(namespace="test")
+    svc = OnlineDetectionService(params, model, cfg=cfg, registry=reg)
+    svc.start()
+    traces = {"a": _sim(seed=23, duration=45.0),
+              "b": _sim(seed=29, duration=45.0, attack=False)}
+    dets = {}
+    try:
+        for sid in traces:
+            svc.join(sid)
+        # interleave the two streams' blocks, as concurrent drains would
+        blocks = {sid: list(_blocks(traces[sid], size=150))
+                  for sid in traces}
+        for i in range(max(len(b) for b in blocks.values())):
+            for sid in traces:
+                if i < len(blocks[sid]):
+                    svc.feed(sid, blocks[sid][i], traces[sid].strings)
+        for sid in traces:
+            dets[sid] = svc.leave(sid, timeout=60.0)
+    finally:
+        svc.stop()
+    tag = "256n/512e/64s"
+    assert reg.value("serve_batch_occupancy", labels={"bucket": tag},
+                     stat="mean") > 1.0
+    assert reg.value("serve_recompiles_total", labels={"bucket": tag}) == 0
+    for sid, tr in traces.items():
+        offline = model_detect(
+            Trace(events=tr.events, strings=tr.strings, ground_truth=None,
+                  labels=None, name=sid),
+            params, model, ds_cfg=cfg.dataset_config(BUCKET_B),
+            auto_capacity=False, batch_size=cfg.batch_size)
+        assert dets[sid].file_scores == offline.file_scores, sid
+        assert dets[sid].file_window_scores == offline.file_window_scores, sid

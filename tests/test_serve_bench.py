@@ -1,0 +1,37 @@
+"""Serve-plane throughput smoke (tier-1-safe: 2 streams, a few seconds of
+serving after one small-model compile) + the checked-in artifact's
+acceptance gates.  bench.py runs the same smoke, so a serving regression
+surfaces both here and in BENCH_*.json."""
+
+import json
+import sys
+
+
+def test_serve_bench_smoke_runs_and_keeps_parity(repo_root):
+    sys.path.insert(0, str(repo_root / "benchmarks"))
+    from run_serve_bench import run
+
+    res = run(smoke=True, log=None)
+    assert res["streams"] == 2
+    assert res["windows_scored"] > 0
+    assert res["value"] > 0  # events/s
+    assert res["recompiles_after_warmup"] == 0
+    assert res["parity"]["bit_identical_to_model_detect"] is True
+    assert res["batch"]["occupancy_mean"] >= 1.0
+    assert res["window_to_alert_latency_ms"]["p99"] is not None
+    assert res["stream_errors"] is None
+
+
+def test_checked_in_serve_artifact_meets_acceptance(repo_root):
+    """The CPU artifact of record: ≥8 concurrent streams through shared
+    batches, measured occupancy ≥2 at the dominant bucket, zero recompiles
+    after warmup, p99 window-to-alert latency reported, and the
+    single-stream result bit-identical to offline model_detect."""
+    art = json.loads((repo_root / "benchmarks" / "results" /
+                      "serve_bench_cpu.json").read_text())
+    assert art["streams"] >= 8
+    assert art["batch"]["occupancy_mean"] >= 2.0
+    assert art["recompiles_after_warmup"] == 0
+    assert art["parity"]["bit_identical_to_model_detect"] is True
+    assert art["window_to_alert_latency_ms"]["p99"] is not None
+    assert art["windows_scored"] >= art["streams"]
