@@ -516,14 +516,34 @@ def main() -> None:
             "provenance": "python benchmarks/run_tracker_bench.py",
         }
 
-    def _serve():
-        # live smoke (2 streams, ~5 s of serving) so a serve-plane
-        # regression surfaces in EVERY bench artifact, not just when the
-        # checked-in artifact is refreshed; the child is pinned to this
-        # run's resolved backend so it can never hang probing a dead
-        # tunnel.  Falls back to the checked-in CPU artifact on failure.
+    def _smoke_or_artifact(name, script, artifact, surface):
+        # live smoke so a regression surfaces in EVERY bench artifact, not
+        # just when the checked-in artifact is refreshed; the child is
+        # pinned to this run's resolved backend so it can never hang
+        # probing a dead tunnel.  Falls back to the checked-in CPU
+        # artifact on failure.
         import subprocess
 
+        try:
+            env = dict(os.environ, JAX_PLATFORMS=backend)
+            r = subprocess.run(
+                [sys.executable,
+                 os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "benchmarks", script),
+                 "--smoke"],
+                capture_output=True, text=True, timeout=600, env=env)
+            line = r.stdout.strip().splitlines()[-1]
+            return surface(json.loads(line))
+        except Exception as e:  # noqa: BLE001 — fall back to the artifact
+            log(f"[bench] {name} smoke failed ({e!r}); surfacing the "
+                "checked-in artifact")
+        p = os.path.join(art_dir, artifact)
+        if not os.path.exists(p):
+            return None
+        return surface(json.load(open(p)))
+
+    def _serve():
+        # 2 streams, ~5 s of serving through the full wire path
         def surface(r):
             return {
                 "streams": r.get("streams"),
@@ -539,29 +559,40 @@ def main() -> None:
                 "provenance": r.get("provenance"),
             }
 
-        try:
-            env = dict(os.environ, JAX_PLATFORMS=backend)
-            r = subprocess.run(
-                [sys.executable,
-                 os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                              "benchmarks", "run_serve_bench.py"),
-                 "--smoke"],
-                capture_output=True, text=True, timeout=600, env=env)
-            line = r.stdout.strip().splitlines()[-1]
-            return surface(json.loads(line))
-        except Exception as e:  # noqa: BLE001 — fall back to the artifact
-            log(f"[bench] serve smoke failed ({e!r}); surfacing the "
-                "checked-in artifact")
-        p = os.path.join(art_dir, "serve_bench_cpu.json")
-        if not os.path.exists(p):
-            return None
-        return surface(json.load(open(p)))
+        return _smoke_or_artifact("serve", "run_serve_bench.py",
+                                  "serve_bench_cpu.json", surface)
+
+    def _swap():
+        # model-lifecycle hot-swap: 2 streams, one mid-run swap + rollback
+        def surface(r):
+            return {
+                "streams": r.get("streams"),
+                "windows_scored_v1": r.get("swap", {}).get(
+                    "windows_scored_v1"),
+                "windows_scored_v2": r.get("swap", {}).get(
+                    "windows_scored_v2"),
+                "flip_at_one_batch_boundary": r.get("swap", {}).get(
+                    "flip_at_one_batch_boundary"),
+                "zero_dropped": r.get("zero_dropped"),
+                "recompiles_after_warmup": r.get("recompiles_after_warmup"),
+                "shadow_vetoes": r.get("shadow", {}).get("vetoes"),
+                "parity_v2": r.get("parity", {}).get(
+                    "live_v2_bit_identical_to_model_detect"),
+                "parity_after_rollback": r.get("parity", {}).get(
+                    "rollback_v1_bit_identical_to_model_detect"),
+                "backend": r.get("backend"),
+                "smoke": r.get("smoke"),
+                "provenance": r.get("provenance"),
+            }
+
+        return _smoke_or_artifact("swap", "run_swap_bench.py",
+                                  "swap_bench_cpu.json", surface)
 
     # per-artifact isolation: one truncated/corrupt JSON on disk must not
     # silently drop the valid artifacts after it
     for key, loader in (("corpus100h", _j100), ("adversarial", _adv),
                         ("m1_recovery", _recovery), ("tracker", _tracker),
-                        ("serve", _serve)):
+                        ("serve", _serve), ("model_swap", _swap)):
         try:
             entry = loader()
             if entry is not None:

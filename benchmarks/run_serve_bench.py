@@ -120,7 +120,7 @@ def run(streams: int = 8, sim_seconds: float = 90.0,
     svc.stop()
 
     tag = bucket_tag(tuple(bucket))
-    lat_ms = sorted(1e3 * lat for _, _, lat, _ in window_log)
+    lat_ms = sorted(1e3 * entry[2] for entry in window_log)
 
     def pct(p):
         return round(lat_ms[min(int(p * len(lat_ms)), len(lat_ms) - 1)], 1) \

@@ -1,0 +1,351 @@
+#!/usr/bin/env python3
+"""Swap-under-load harness: hot-swap the live model mid-run, prove zero
+dropped windows, zero recompiles, a bounded latency spike, and per-window
+version stamps flipping at exactly one batch boundary.
+
+The run exercises the full lifecycle against a loaded service:
+
+  1. publish v1 + v2 into a fresh registry, promote v1, boot the service
+     from the lineage (ModelManager attached, polling);
+  2. drive N concurrent wire streams at steady state — the manager stages
+     v2 as a SHADOW candidate (two independently-initialized models
+     disagree wildly, so the guardrails VETO it: the negative path is
+     exercised live);
+  3. mid-run, `promote` v2 manually (the pointer move every `nerrf models
+     promote` does) — the manager hot-swaps under load: no stream
+     restarts, no recompiles, no window lost;
+  4. after the streams drain, replay one stream against the (now-v2)
+     service and assert bit-parity with offline `model_detect` at v2;
+  5. `rollback`, wait for the swap back, replay again and assert
+     bit-parity with v1 — every window of the replay stamped v1 (the
+     "restored within one batch boundary" criterion).
+
+Prints ONE JSON artifact line on stdout; exits 1 when any gate fails.
+
+    python benchmarks/run_swap_bench.py            # 4 streams
+    python benchmarks/run_swap_bench.py --smoke    # 2 streams, shorter
+    python benchmarks/run_swap_bench.py --out results/swap_bench_cpu.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+
+def _blocks(events, size=200):
+    for i in range(0, len(events), size):
+        yield type(events)(**{f.name: getattr(events, f.name)[i:i + size]
+                              for f in dataclasses.fields(events)})
+
+
+def _replay_stream(svc, stream_id, trace):
+    """Feed one accumulated trace through join → feed… → leave (the
+    parity-leg path; the main load phase uses the real wire)."""
+    svc.join(stream_id)
+    for b in _blocks(trace.events):
+        svc.feed(stream_id, b, trace.strings)
+    return svc.leave(stream_id, timeout=120.0)
+
+
+def _percentile(sorted_ms, p):
+    if not sorted_ms:
+        return None
+    return round(sorted_ms[min(int(p * len(sorted_ms)),
+                               len(sorted_ms) - 1)], 1)
+
+
+def run(streams: int = 4, sim_seconds: float = 60.0,
+        bucket=(256, 512, 128), batch_size: int = 8,
+        close_ms: float = 100.0, poll_sec: float = 0.2,
+        smoke: bool = False,
+        log=lambda *a: print(*a, file=sys.stderr, flush=True)) -> dict:
+    """Importable harness body (the tier-1 smoke test calls this
+    in-process).  Returns the artifact dict."""
+    if smoke:
+        streams, sim_seconds = 2, 30.0
+    log = log or (lambda *a: None)
+    import threading
+
+    import jax
+
+    from nerrf_tpu.data.synth import SimConfig, simulate_trace
+    from nerrf_tpu.models import JointConfig, NerrfNet
+    from nerrf_tpu.observability import MetricsRegistry
+    from nerrf_tpu.pipeline import model_detect
+    from nerrf_tpu.registry import ModelManager, ModelRegistry, RegistryConfig
+    from nerrf_tpu.serve import (
+        OnlineDetectionService,
+        ServeConfig,
+        bucket_tag,
+        init_untrained_params,
+    )
+    from nerrf_tpu.train.checkpoint import save_checkpoint
+
+    backend = jax.default_backend()
+    bucket = tuple(bucket)
+    cfg = ServeConfig(
+        buckets=(bucket,), batch_size=batch_size,
+        batch_close_sec=close_ms / 1000.0,
+        window_sec=15.0, stride_sec=5.0,
+        stream_queue_slots=512, alert_queue_slots=4096,
+        window_deadline_sec=2.0)
+    model_cfg = JointConfig().small
+    model = NerrfNet(model_cfg)
+    # two independently-initialized "trainings": same architecture, very
+    # different scores — v1 is the incumbent, v2 the retrained candidate
+    params_v1 = init_untrained_params(model, cfg, seed=0)
+    params_v2 = init_untrained_params(model, cfg, seed=7)
+
+    workdir = tempfile.mkdtemp(prefix="nerrf-swap-bench-")
+    store = ModelRegistry(Path(workdir) / "registry")
+    for p in (params_v1, params_v2):
+        with tempfile.TemporaryDirectory() as td:
+            ckpt = Path(td) / "model"
+            save_checkpoint(ckpt, p, model_cfg)
+            store.publish("default", ckpt, source="swap-bench")
+    store.promote("default", 1)
+
+    registry = MetricsRegistry(namespace="bench")
+    mgr = ModelManager(
+        store, "default",
+        cfg=RegistryConfig(poll_sec=poll_sec, shadow_min_windows=8,
+                           canary_windows=4),
+        registry=registry, log=log)
+    params, booted_cfg, _calib, _v = mgr.boot()
+    window_log: list = []
+    svc = OnlineDetectionService(params, NerrfNet(booted_cfg), cfg=cfg,
+                                 registry=registry, window_log=window_log)
+    mgr.attach(svc)
+    t0 = time.perf_counter()
+    svc.start(log=log)
+    warmup_wall = round(time.perf_counter() - t0, 1)
+    mgr.start_polling()
+
+    # N concurrent PACED stream actors: each spreads its trace over the
+    # load window so the swap lands mid-run with windows in flight on both
+    # sides (the full wire path is run_serve_bench's job; this harness is
+    # about the swap)
+    load_sec = 6.0 if smoke else 12.0
+    traces = [simulate_trace(SimConfig(
+        duration_sec=sim_seconds, attack=(i % 2 == 0),
+        attack_start_sec=sim_seconds / 3, num_target_files=4,
+        benign_rate_hz=6.0, seed=2000 + 31 * i)) for i in range(streams)]
+    results: dict = {}
+    errors: dict = {}
+
+    def actor(i: int) -> None:
+        sid, tr = f"s{i}", traces[i]
+        try:
+            svc.join(sid)
+            blocks = list(_blocks(tr.events, size=150))
+            pace = load_sec / max(len(blocks), 1)
+            for b in blocks:
+                svc.feed(sid, b, tr.strings)
+                time.sleep(pace)
+            results[sid] = svc.leave(sid, timeout=120.0)
+        except Exception as e:  # noqa: BLE001 — surfaced in the artifact
+            errors[sid] = repr(e)
+
+    t_run = time.perf_counter()
+    threads = [threading.Thread(target=actor, args=(i,), daemon=True)
+               for i in range(streams)]
+    for t in threads:
+        t.start()
+
+    # steady state, then promote v2 mid-run (the shadow veto for v2 has
+    # usually landed by now — two random models disagree on most nodes)
+    expect_windows = streams * max(int(sim_seconds // 5) - 3, 2)
+    deadline = time.monotonic() + 300.0
+    target_scored = expect_windows / (3 if smoke else 2)
+    while registry.value("serve_windows_scored_total") < target_scored \
+            and time.monotonic() < deadline:
+        time.sleep(0.05)
+    vetoes = registry.value("registry_shadow_vetoes_total",
+                            labels={"lineage": "default"})
+    store.promote("default", 2)
+    t_swap = time.perf_counter()
+    log(f"[swap-bench] promoted v2 at "
+        f"{registry.value('serve_windows_scored_total'):.0f} windows scored")
+
+    for t in threads:
+        t.join(timeout=600.0)
+    wall = time.perf_counter() - t_run
+    swapped = svc.live_version == 2
+    main_log = list(window_log)  # snapshot before the parity replays
+
+    # -- the flip: version stamps change at EXACTLY one batch boundary ------
+    versions = [e[4] for e in main_log]
+    n_v1 = sum(1 for v in versions if v == 1)
+    n_v2 = sum(1 for v in versions if v == 2)
+    flip_clean = (versions == sorted(versions)  # monotone in scoring order
+                  and set(versions) <= {1, 2} and n_v1 > 0 and n_v2 > 0)
+
+    # -- bounded p99 spike: scored-latency before vs after the swap ---------
+    pre_ms = sorted(1e3 * e[2] for e in main_log if e[4] == 1)
+    post_ms = sorted(1e3 * e[2] for e in main_log if e[4] == 2)
+    p99_pre, p99_post = _percentile(pre_ms, 0.99), _percentile(post_ms, 0.99)
+    spike_bounded = (p99_pre is not None and p99_post is not None
+                     and p99_post <= max(4 * p99_pre, p99_pre + 500.0))
+
+    # -- zero dropped windows, zero recompiles ------------------------------
+    tag = bucket_tag(bucket)
+    dropped = {reason: int(registry.value(
+        "serve_admission_dropped_total", labels={"reason": reason}))
+        for reason in ("backpressure", "oversize", "leave", "closed")}
+    recompiles = int(registry.value("serve_recompiles_total",
+                                    labels={"bucket": tag}))
+
+    # -- parity at v2, then rollback and parity at v1 -----------------------
+    from nerrf_tpu.data.loaders import Trace
+
+    tr0 = traces[0]
+    ref_trace = Trace(events=tr0.events, strings=tr0.strings,
+                      ground_truth=None, labels=None, name="parity")
+    ds_cfg = cfg.dataset_config(bucket)
+
+    def parity_against(params_ref, stream_id):
+        before = len(window_log)
+        served = _replay_stream(svc, stream_id, ref_trace)
+        offline = model_detect(ref_trace, params_ref, model, ds_cfg=ds_cfg,
+                               auto_capacity=False, batch_size=batch_size)
+        replay_versions = sorted({e[4] for e in window_log[before:]})
+        return (served.file_scores == offline.file_scores
+                and served.file_window_scores == offline.file_window_scores
+                and served.proc_scores == offline.proc_scores
+                and served.threshold == offline.threshold), replay_versions
+
+    parity_v2, v2_stamps = parity_against(params_v2, "parity-v2")
+
+    store.rollback("default")
+    rb_deadline = time.monotonic() + 30.0
+    while svc.live_version != 1 and time.monotonic() < rb_deadline:
+        time.sleep(0.05)
+    rolled_back = svc.live_version == 1
+    parity_v1, v1_stamps = parity_against(params_v1, "parity-rollback")
+
+    mgr.close()
+    svc.stop()
+
+    result = {
+        "metric": "swap_under_load",
+        "value": int(n_v1 + n_v2),
+        "unit": f"windows scored across a mid-run hot-swap "
+                f"({streams} concurrent paced streams)",
+        "backend": backend,
+        "smoke": smoke or None,
+        "streams": streams,
+        "wall_seconds": round(wall, 2),
+        "warmup_seconds": warmup_wall,
+        "swap": {
+            "swapped_to_v2": swapped,
+            "windows_scored_v1": n_v1,
+            "windows_scored_v2": n_v2,
+            "flip_at_one_batch_boundary": flip_clean,
+            "swap_at_seconds": round(t_swap - t_run, 2),
+        },
+        "shadow": {
+            # gauges retain the last observation even after a veto retires
+            # the shadow, so the artifact records what the guardrails saw
+            "vetoes": int(vetoes),
+            "disagreement_rate": round(registry.value(
+                "registry_shadow_disagreement_rate",
+                labels={"lineage": "default"}), 4),
+            "score_drift": round(registry.value(
+                "registry_shadow_score_drift",
+                labels={"lineage": "default"}), 4),
+            "windows": int(registry.value(
+                "registry_shadow_windows_total",
+                labels={"lineage": "default"})),
+        },
+        "dropped_windows": dropped,
+        "zero_dropped": not any(dropped.values()),
+        "recompiles_after_warmup": recompiles,
+        "latency_ms": {
+            "p50_before_swap": _percentile(pre_ms, 0.50),
+            "p50_after_swap": _percentile(post_ms, 0.50),
+            "p99_before_swap": p99_pre,
+            "p99_after_swap": p99_post,
+            "spike_bounded": spike_bounded,
+        },
+        "parity": {
+            "live_v2_bit_identical_to_model_detect": bool(parity_v2),
+            "v2_replay_version_stamps": v2_stamps,
+            "rollback_applied": rolled_back,
+            "rollback_v1_bit_identical_to_model_detect": bool(parity_v1),
+            "rollback_replay_version_stamps": v1_stamps,
+        },
+        "stream_detectors": {sid: det.detector
+                             for sid, det in sorted(results.items())},
+        "stream_errors": errors or None,
+        "provenance": "python benchmarks/run_swap_bench.py"
+                      + (" --smoke" if smoke else ""),
+    }
+    return result
+
+
+def gates(result: dict) -> list:
+    """The acceptance gates; empty list = pass."""
+    failures = []
+    if not result["swap"]["swapped_to_v2"]:
+        failures.append("service never swapped to v2")
+    if not result["swap"]["flip_at_one_batch_boundary"]:
+        failures.append("version stamps did not flip at one batch boundary")
+    if not result["zero_dropped"]:
+        failures.append(f"windows dropped: {result['dropped_windows']}")
+    if result["recompiles_after_warmup"] != 0:
+        failures.append("the swap triggered a recompile")
+    if not result["latency_ms"]["spike_bounded"]:
+        failures.append(f"p99 spike unbounded: {result['latency_ms']}")
+    if not result["parity"]["live_v2_bit_identical_to_model_detect"]:
+        failures.append("v2 parity with offline model_detect failed")
+    if not result["parity"]["rollback_applied"]:
+        failures.append("rollback never applied")
+    if not result["parity"]["rollback_v1_bit_identical_to_model_detect"]:
+        failures.append("post-rollback v1 parity failed")
+    if result["parity"]["rollback_replay_version_stamps"] != [1]:
+        failures.append("rollback replay not wholly scored by v1")
+    if result["stream_errors"]:
+        failures.append(f"stream errors: {result['stream_errors']}")
+    return failures
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--streams", type=int, default=4)
+    ap.add_argument("--seconds", type=float, default=60.0,
+                    help="simulated seconds of trace per stream")
+    ap.add_argument("--bucket", default="256x512x128", metavar="NxExS")
+    ap.add_argument("--batch-size", type=int, default=8)
+    ap.add_argument("--close-ms", type=float, default=100.0)
+    ap.add_argument("--smoke", action="store_true",
+                    help="2 streams, short traces")
+    ap.add_argument("--out", default=None, metavar="FILE",
+                    help="also write the artifact JSON here")
+    args = ap.parse_args(argv)
+
+    result = run(streams=args.streams, sim_seconds=args.seconds,
+                 bucket=tuple(int(x) for x in args.bucket.split("x")),
+                 batch_size=args.batch_size, close_ms=args.close_ms,
+                 smoke=args.smoke)
+    print(json.dumps(result))
+    if args.out:
+        os.makedirs(os.path.dirname(os.path.abspath(args.out)), exist_ok=True)
+        with open(args.out, "w") as f:
+            f.write(json.dumps(result, indent=2) + "\n")
+    failures = gates(result)
+    for f in failures:
+        print(f"[swap-bench] GATE FAILED: {f}", file=sys.stderr)
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -121,6 +121,25 @@ def cmd_train_detector(args) -> int:
     calibrate_and_resave(args.model_dir, res.state.params, model_cfg,
                          node_loss_weight=train_cfg.node_loss_weight,
                          log=_log)
+    if args.publish:
+        # the train→serve hand-off: publish the calibrated checkpoint into
+        # the registry lineage (immutable version, schema/feature-gated at
+        # publish).  Promotion stays separate — a resident serve pod picks
+        # the version up as a SHADOW candidate and promotes only when the
+        # guardrails pass (docs/model-lifecycle.md).  Best-effort: a
+        # registry failure must not turn a finished training run into a
+        # CLI failure — the checkpoint is already safe under --model-dir.
+        try:
+            from nerrf_tpu.registry import ModelRegistry
+
+            version = ModelRegistry(args.publish).publish(
+                args.lineage, args.model_dir,
+                source=f"nerrf train-detector --steps {args.steps}")
+            _log(f"published {args.model_dir} as {args.lineage}/v{version} "
+                 f"in {args.publish}")
+        except Exception as e:  # noqa: BLE001
+            _log(f"registry publish failed ({type(e).__name__}: {e}); "
+                 f"checkpoint remains at {args.model_dir}")
     return 0 if res.metrics["edge_auc"] >= 0.9 else 1
 
 
@@ -240,6 +259,41 @@ def cmd_undo(args) -> int:
          f"({report.mb_per_sec:.0f} MB/s), verified={report.verified}, "
          f"MTTR={mttr:.2f}s")
     return 0 if report.verified else 4
+
+
+# --------------------------------------------------------------------------
+def cmd_models(args) -> int:
+    """Model lifecycle registry: publish → (shadow) → promote → rollback.
+    Every action prints one JSON document; the registry layout and the
+    promotion guardrails are documented in docs/model-lifecycle.md."""
+    from nerrf_tpu.registry import ModelRegistry
+
+    reg = ModelRegistry(args.registry)
+    out: dict
+    if args.models_cmd == "publish":
+        version = reg.publish(args.lineage, args.model_dir,
+                              source=args.source)
+        out = {"lineage": args.lineage, "published": version,
+               "path": str(reg.version_dir(args.lineage, version))}
+        if args.promote:
+            out["live"] = reg.promote(args.lineage, version)
+    elif args.models_cmd == "list":
+        lineages = [args.lineage] if args.lineage else reg.lineages()
+        out = {"registry": str(reg.root),
+               "lineages": {ln: reg.status(ln) for ln in lineages}}
+    elif args.models_cmd == "promote":
+        out = {"lineage": args.lineage,
+               "live": reg.promote(args.lineage, args.version)}
+    elif args.models_cmd == "rollback":
+        out = {"lineage": args.lineage,
+               "live": reg.rollback(args.lineage, args.version)}
+    elif args.models_cmd == "status":
+        out = reg.status(args.lineage)
+    else:  # pragma: no cover — argparse enforces the choices
+        _log(f"unknown models subcommand {args.models_cmd!r}")
+        return 2
+    print(json.dumps(out, indent=2))
+    return 0
 
 
 # --------------------------------------------------------------------------
@@ -418,7 +472,28 @@ def cmd_serve_detect(args) -> int:
             tuple(int(x) for x in b.split("x")) for b in args.buckets)
     cfg = ServeConfig(**cfg_kwargs)
 
-    if args.model_dir:
+    manager = None
+    if args.registry:
+        # registry mode: boot from the lineage's LIVE version and keep a
+        # ModelManager polling — retrained checkpoints published into the
+        # lineage shadow-score and hot-swap in WITHOUT a pod restart or a
+        # recompile (docs/model-lifecycle.md)
+        from nerrf_tpu.registry import (
+            ModelManager,
+            ModelRegistry,
+            RegistryConfig,
+        )
+
+        manager = ModelManager(
+            ModelRegistry(args.registry), args.lineage,
+            cfg=RegistryConfig(poll_sec=args.poll_sec), log=_log)
+        params, model_cfg, calib, version = manager.boot()
+        model = NerrfNet(model_cfg)
+        if calib.get("node_threshold") is not None:
+            cfg = _dc.replace(cfg, threshold=calib["node_threshold"])
+        _log(f"registry boot: {args.lineage}/v{version} LIVE "
+             f"from {args.registry}")
+    elif args.model_dir:
         from nerrf_tpu.train.checkpoint import load_calibration, load_checkpoint
 
         params, model_cfg = load_checkpoint(args.model_dir)
@@ -433,6 +508,9 @@ def cmd_serve_detect(args) -> int:
         params = init_untrained_params(model, cfg)
 
     service = OnlineDetectionService(params, model, cfg=cfg)
+    if manager is not None:
+        manager.attach(service)
+        manager.start_polling()
     metrics = None
     if args.metrics_port >= 0:
         # readiness is live from the first probe: k8s sees "booting" (503)
@@ -509,6 +587,8 @@ def cmd_serve_detect(args) -> int:
 
         summary["windows_scored"] = DEFAULT_REGISTRY.value(
             "serve_windows_scored_total")
+        if service.live_version is not None:
+            summary["model_version"] = f"v{service.live_version}"
         summary["admission_dropped"] = {
             reason: DEFAULT_REGISTRY.value(
                 "serve_admission_dropped_total", labels={"reason": reason})
@@ -516,6 +596,8 @@ def cmd_serve_detect(args) -> int:
         print(json.dumps(summary, indent=2))
         return 0
     finally:
+        if manager is not None:
+            manager.close()
         service.stop()
         for rs in replays:
             rs.stop()
@@ -641,7 +723,53 @@ def main(argv=None) -> int:
     p.add_argument("--trace-out", default=None, metavar="FILE",
                    help="write a Chrome-trace JSON of the run's host spans "
                         "(enables per-step synced attribution spans)")
+    p.add_argument("--publish", default=None, metavar="REGISTRY",
+                   help="also publish the calibrated checkpoint into this "
+                        "model registry (immutable version; promotion is "
+                        "separate — see `nerrf models`)")
+    p.add_argument("--lineage", default="default",
+                   help="registry lineage to publish into (with --publish)")
     p.set_defaults(fn=cmd_train_detector)
+
+    p = sub.add_parser("models", help="model lifecycle registry: publish, "
+                                      "list, promote, rollback, status")
+    msub = p.add_subparsers(dest="models_cmd", required=True)
+
+    def _models_common(mp, lineage_required=True):
+        mp.add_argument("--registry", required=True, metavar="DIR",
+                        help="registry root (the serve pods' --registry)")
+        # `list` alone leaves --lineage optional (None = every lineage)
+        mp.add_argument("--lineage", required=lineage_required, default=None,
+                        help="model lineage name")
+        mp.set_defaults(fn=cmd_models)
+
+    mp = msub.add_parser("publish", help="copy a checkpoint in as the next "
+                                         "immutable version (schema/feature "
+                                         "gated)")
+    _models_common(mp)
+    mp.add_argument("--model-dir", required=True,
+                    help="checkpoint directory to publish")
+    mp.add_argument("--source", default=None,
+                    help="provenance note stamped into the version sidecar")
+    mp.add_argument("--promote", action="store_true",
+                    help="also repoint LIVE at the new version immediately "
+                        "(skips shadow scoring — prefer guarded promotion)")
+    mp = msub.add_parser("list", help="lineages, versions, LIVE pointers")
+    _models_common(mp, lineage_required=False)
+    mp = msub.add_parser("promote", help="repoint LIVE at a version "
+                                         "(atomic; pods hot-swap on their "
+                                         "next poll)")
+    _models_common(mp)
+    mp.add_argument("--version", type=int, required=True)
+    mp = msub.add_parser("rollback", help="one-command rollback: repoint "
+                                          "LIVE at the previous (or given) "
+                                          "version")
+    _models_common(mp)
+    mp.add_argument("--version", type=int, default=None,
+                    help="explicit version to roll back to (default: the "
+                         "LIVE pointer's recorded previous)")
+    mp = msub.add_parser("status", help="one lineage's versions + LIVE")
+    _models_common(mp)
 
     p = sub.add_parser("undo", help="detect, plan, rehearse and roll back")
     p.add_argument("--incident", required=True)
@@ -705,6 +833,15 @@ def main(argv=None) -> int:
     p.add_argument("--model-dir", default=None,
                    help="trained detector checkpoint (default: an untrained "
                         "small model, for load testing only)")
+    p.add_argument("--registry", default=None, metavar="DIR",
+                   help="model registry root: boot from the lineage's LIVE "
+                        "version and hot-swap newly promoted versions "
+                        "in-place, no restart, no recompile (overrides "
+                        "--model-dir; see docs/model-lifecycle.md)")
+    p.add_argument("--lineage", default="default",
+                   help="registry lineage to serve (with --registry)")
+    p.add_argument("--poll-sec", type=float, default=10.0,
+                   help="registry poll cadence for new/promoted versions")
     p.add_argument("--target", action="append", default=None,
                    metavar="HOST:PORT",
                    help="tracker endpoint to admit as one stream "

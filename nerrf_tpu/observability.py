@@ -206,9 +206,12 @@ class MetricsServer:
         still compiling its bucket programs.  With no ``ready_check`` the
         server is ready as soon as it is live.
 
-    ``ready_check`` returns either a bool or ``(bool, reason)``; it is
-    called per probe and must be cheap.  An exception counts as unready
-    (the reason is the exception) — a broken check must fail closed.
+    ``ready_check`` returns a bool, ``(bool, reason)``, or
+    ``(bool, reason, extra_dict)`` — extra keys (e.g. the serve plane's
+    live ``model_version``) are merged into the /readyz JSON payload so
+    probes and dashboards can see *which* model is serving.  It is called
+    per probe and must be cheap.  An exception counts as unready (the
+    reason is the exception) — a broken check must fail closed.
     """
 
     def __init__(self, registry: Optional[MetricsRegistry] = None,
@@ -217,16 +220,17 @@ class MetricsServer:
         registry = registry or DEFAULT_REGISTRY
         start_ts = time.time()
 
-        def readiness() -> Tuple[bool, str]:
+        def readiness() -> Tuple[bool, str, dict]:
             if ready_check is None:
-                return True, "ok"
+                return True, "ok", {}
             try:
                 got = ready_check()
             except Exception as e:  # noqa: BLE001 — fail closed
-                return False, f"{type(e).__name__}: {e}"
+                return False, f"{type(e).__name__}: {e}", {}
             if isinstance(got, tuple):
-                return bool(got[0]), str(got[1])
-            return bool(got), "ok" if got else "not ready"
+                extra = dict(got[2]) if len(got) > 2 and got[2] else {}
+                return bool(got[0]), str(got[1]), extra
+            return bool(got), "ok" if got else "not ready", {}
 
         class Handler(http.server.BaseHTTPRequestHandler):
             def do_GET(self) -> None:  # noqa: N802 (stdlib API)
@@ -241,12 +245,13 @@ class MetricsServer:
                     ).encode()
                     ctype = "application/json"
                 elif self.path.startswith("/readyz"):
-                    ok, reason = readiness()
+                    ok, reason, extra = readiness()
                     code = 200 if ok else 503
                     body = (json.dumps({
                         "status": "ready" if ok else "unready",
                         "reason": reason,
                         "uptime_sec": round(time.time() - start_ts, 1),
+                        **extra,
                     }) + "\n").encode()
                     ctype = "application/json"
                 else:

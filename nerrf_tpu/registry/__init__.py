@@ -1,0 +1,29 @@
+"""Model lifecycle registry: versioned checkpoints, zero-downtime
+hot-swap, shadow scoring, and guarded promotion.  See
+docs/model-lifecycle.md for the publish → shadow → promote → rollback
+walkthrough."""
+
+from nerrf_tpu.registry.config import RegistryConfig
+from nerrf_tpu.registry.guardrails import (
+    PROMOTE,
+    VETO,
+    WAIT,
+    ShadowStats,
+    evaluate,
+    make_stats,
+)
+from nerrf_tpu.registry.manager import ModelManager
+from nerrf_tpu.registry.store import ModelRegistry, validate_checkpoint_dir
+
+__all__ = [
+    "PROMOTE",
+    "VETO",
+    "WAIT",
+    "ModelManager",
+    "ModelRegistry",
+    "RegistryConfig",
+    "ShadowStats",
+    "evaluate",
+    "make_stats",
+    "validate_checkpoint_dir",
+]

@@ -1,0 +1,352 @@
+"""The in-process model lifecycle manager: registry poll → shadow →
+guarded promotion → zero-downtime hot-swap.
+
+`ModelManager` runs inside the serve process (Podracer split, arXiv:
+2104.06272: learners publish versioned weights, resident actors swap them
+in-place).  Because the serve plane's bucket ladder fixes every pytree
+shape, applying a new version is a `device_put` plus a pointer swap under
+the service's swap lock — the compiled per-bucket eval programs are keyed
+on shapes, so a swap never recompiles and never drops a window.
+
+Lifecycle, as the poll loop sees it:
+
+  * **LIVE moved** (promote or rollback, from any process) → load, gate
+    (pytree + architecture compatibility), stage to device, swap.
+  * **a newer version exists but LIVE did not move** → stage it as the
+    SHADOW candidate: every live batch is also scored by the candidate
+    (``registry_shadow_score`` spans), the paired disagreement/drift
+    statistics export as ``nerrf_registry_*`` metrics, and when the
+    guardrails pass (`guardrails.evaluate`) the manager auto-promotes —
+    repoints LIVE in the registry, then swaps in-process.  A guardrail
+    veto stops the shadow and remembers the version so it is never
+    re-staged.
+
+Every decision is also available synchronously: `poll()` is reentrant-safe
+and is what `nerrf models`-poked deployments call.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from nerrf_tpu.registry.config import RegistryConfig
+from nerrf_tpu.registry.guardrails import (
+    PROMOTE,
+    VETO,
+    evaluate,
+    make_stats,
+)
+from nerrf_tpu.registry.store import ModelRegistry
+from nerrf_tpu.tracing import span as trace_span
+
+
+class ModelManager:
+    def __init__(self, store: ModelRegistry, lineage: str,
+                 cfg: Optional[RegistryConfig] = None,
+                 registry=None, log=None) -> None:
+        if registry is None:
+            from nerrf_tpu.observability import DEFAULT_REGISTRY
+
+            registry = DEFAULT_REGISTRY
+        self.store = store
+        self.lineage = lineage
+        self.cfg = cfg or RegistryConfig()
+        self._reg = registry
+        self._log = log or (lambda msg: None)
+        self._service = None
+        self._version: Optional[int] = None
+        self._shadow_version: Optional[int] = None
+        self._stats = None
+        self._vetoed: set = set()
+        self._poll_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- boot -----------------------------------------------------------------
+
+    def boot(self):
+        """Load the lineage's LIVE checkpoint for service construction.
+        → (params, JointConfig, calibration, version)."""
+        params, model_cfg, calibration, version = self.store.load(
+            self.lineage)
+        self._version = version
+        return params, model_cfg, calibration, version
+
+    def attach(self, service) -> "ModelManager":
+        """Bind to a started `OnlineDetectionService` (the service calls
+        back into `observe_shadow` from its scorer thread)."""
+        self._service = service
+        service.attach_manager(self)
+        if self._version is None:
+            self._version = service.live_version
+        elif service.live_version is None:
+            # the service was constructed from boot()'s params before any
+            # swap: stamp the booted version so results carry it from the
+            # first scored window
+            with service._swap_lock:
+                service._live_version = self._version
+        self._stamp_info(self._version)
+        return self
+
+    @property
+    def live_version(self) -> Optional[int]:
+        return self._version
+
+    @property
+    def shadow_version(self) -> Optional[int]:
+        return self._shadow_version
+
+    # -- metrics --------------------------------------------------------------
+
+    def _stamp_info(self, version: Optional[int],
+                    previous: Optional[int] = None) -> None:
+        """`nerrf_build_info`-style identity gauge: exactly one series per
+        lineage is 1 (the serving version); a swapped-out version's series
+        drops to 0 so dashboards see the flip, not two live models."""
+        if previous is not None and previous != version:
+            self._reg.gauge_set(
+                "model_info", 0.0,
+                labels={"lineage": self.lineage, "version": f"v{previous}"},
+                help="1 for the model version currently serving "
+                     "this lineage")
+        if version is not None:
+            self._reg.gauge_set(
+                "model_info", 1.0,
+                labels={"lineage": self.lineage, "version": f"v{version}"},
+                help="1 for the model version currently serving "
+                     "this lineage")
+
+    # -- shadow observation (scorer thread) -----------------------------------
+
+    def observe_shadow(self, live_probs, shadow_probs, node_mask,
+                       version: int) -> None:
+        stats = self._stats
+        if stats is None or version != self._shadow_version:
+            return  # a batch scored against an already-retired shadow
+        stats.observe(live_probs, shadow_probs, node_mask)
+        self._reg.counter_inc(
+            "registry_shadow_windows_total",
+            labels={"lineage": self.lineage},
+            help="windows scored by a shadow candidate alongside the "
+                 "live model")
+        snap = stats.snapshot()
+        self._reg.gauge_set(
+            "registry_shadow_disagreement_rate", snap["disagreement_rate"],
+            labels={"lineage": self.lineage},
+            help="fraction of real-node decisions the shadow candidate "
+                 "flips vs live (paired, same batches)")
+        self._reg.gauge_set(
+            "registry_shadow_score_drift", snap["score_drift"],
+            labels={"lineage": self.lineage},
+            help="mean |p_shadow - p_live| over real nodes (score-"
+                 "distribution drift)")
+
+    # -- the poll step --------------------------------------------------------
+
+    def poll(self) -> dict:
+        """One lifecycle step; called by the poll thread, a CLI poke, or a
+        test.  Returns a record of what (if anything) happened."""
+        with self._poll_lock:
+            return self._poll_locked()
+
+    def _poll_locked(self) -> dict:
+        out = {"live": self._version, "shadow": self._shadow_version,
+               "action": "none"}
+        try:
+            live_rec = self.store.live(self.lineage)
+        except (OSError, ValueError) as e:
+            out.update(action="error", error=f"{type(e).__name__}: {e}")
+            return out
+        target = int(live_rec["version"]) if live_rec else None
+        # 1) the pointer moved (promote/rollback from anywhere): follow it
+        if target is not None and target != self._version:
+            return self._apply(target, out)
+        # 2) a newer published version: stage it as the shadow candidate.
+        # The floor is the newest version that has EVER been LIVE (the
+        # pointer records its predecessor), not just the current one —
+        # after a rollback v2→v1 the floor stays 2, so the version the
+        # operator just rolled back from is never re-staged and silently
+        # re-promoted, even by a freshly restarted pod whose in-memory
+        # veto set is empty
+        floor = max(target or 0,
+                    int((live_rec or {}).get("previous") or 0))
+        newest = max(
+            (v for v in self.store.versions(self.lineage)
+             if v > floor and v not in self._vetoed),
+            default=None)
+        if newest is not None and newest != self._shadow_version:
+            return self._start_shadow(newest, out)
+        # 3) judge the running shadow
+        if self._shadow_version is not None and self._stats is not None:
+            verdict, reason = evaluate(self._stats, self.cfg)
+            out.update(verdict=verdict, reason=reason)
+            if verdict == PROMOTE and self.cfg.auto_promote:
+                try:
+                    self.store.promote(self.lineage, self._shadow_version,
+                                       kind="auto")
+                except OSError as e:
+                    # an unwritable registry (read-only mount, transient
+                    # volume error) must not wedge the poll loop with the
+                    # shadow double-scoring forever on a promotion that
+                    # can never land: veto locally and surface the error
+                    self._log(f"registry: auto-promotion of "
+                              f"v{self._shadow_version} cannot write the "
+                              f"registry ({e}); unstaging the candidate — "
+                              f"promote it with `nerrf models promote` "
+                              f"from a host with write access")
+                    self._vetoed.add(self._shadow_version)
+                    out.update(action="error",
+                               error=f"promote v{self._shadow_version}: {e}")
+                    self._retire_shadow()
+                    return out
+                self._reg.counter_inc(
+                    "registry_promotions_total",
+                    labels={"lineage": self.lineage, "kind": "auto"},
+                    help="candidate versions promoted to LIVE")
+                return self._apply(self._shadow_version, out,
+                                   action="auto_promote")
+            if verdict == VETO:
+                self._vetoed.add(self._shadow_version)
+                self._reg.counter_inc(
+                    "registry_shadow_vetoes_total",
+                    labels={"lineage": self.lineage},
+                    help="shadow candidates rejected by a promotion "
+                         "guardrail")
+                self._log(f"registry: shadow v{self._shadow_version} "
+                          f"vetoed — {reason}")
+                out.update(action="veto", vetoed=self._shadow_version)
+                self._retire_shadow()
+        return out
+
+    def _apply(self, version: int, out: dict, action: str = "swap") -> dict:
+        """Load → gate → stage → atomic swap under the service lock."""
+        try:
+            params, model_cfg, calibration, _ = self.store.load(
+                self.lineage, version)
+        except (OSError, ValueError) as e:
+            out.update(action="error",
+                       error=f"load v{version}: {type(e).__name__}: {e}")
+            return out
+        svc = self._service
+        if svc is not None:
+            if svc.model_config is not None and model_cfg != svc.model_config:
+                # architecture drift the pytree check might not catch
+                # (e.g. fuse mode): refuse — the compiled programs encode
+                # the live architecture.  (model-free services — test
+                # stubs — skip this and rely on the pytree gate.)
+                self._vetoed.add(version)
+                out.update(action="error",
+                           error=f"v{version} architecture {model_cfg} != "
+                                 f"serving {svc.model_config}; not swapped")
+                return out
+            try:
+                with trace_span("registry_swap", lineage=self.lineage,
+                                version=version):
+                    svc.swap_params(
+                        params, version,
+                        threshold=calibration.get("node_threshold"))
+            except ValueError as e:
+                # pytree-signature mismatch: the checkpoint cannot serve on
+                # the compiled programs — veto so the poll loop does not
+                # reload + re-stage it to device every poll_sec forever
+                self._vetoed.add(version)
+                self._log(f"registry: cannot swap to v{version}: {e}")
+                out.update(action="error", error=f"swap v{version}: {e}")
+                return out
+        previous, self._version = self._version, version
+        direction = "rollback" if (previous is not None
+                                   and version < previous) else "forward"
+        if direction == "rollback" and previous is not None:
+            # never re-stage the version the operator just rolled back
+            # from (the candidate floor in _poll_locked enforces the same
+            # across restarts; this covers the running process)
+            self._vetoed.add(previous)
+        self._reg.counter_inc(
+            "registry_swaps_total",
+            labels={"lineage": self.lineage, "direction": direction},
+            help="live param hot-swaps applied in-process (zero-recompile "
+                 "pointer swaps under the batch lock)")
+        self._stamp_info(version, previous=previous)
+        if self._shadow_version is not None and self._shadow_version <= version:
+            self._retire_shadow()
+        self._log(f"registry: live model -> v{version} "
+                  f"(was v{previous}, {direction})")
+        out.update(action=action, live=version, previous=previous,
+                   direction=direction)
+        return out
+
+    def _start_shadow(self, version: int, out: dict) -> dict:
+        try:
+            params, model_cfg, calibration, _ = self.store.load(
+                self.lineage, version)
+        except (OSError, ValueError) as e:
+            out.update(action="error",
+                       error=f"load v{version}: {type(e).__name__}: {e}")
+            return out
+        svc = self._service
+        if svc is not None:
+            if svc.model_config is not None and model_cfg != svc.model_config:
+                self._vetoed.add(version)
+                out.update(action="error",
+                           error=f"shadow v{version} architecture mismatch; "
+                                 f"not staged")
+                return out
+            try:
+                svc.start_shadow(params, version)
+            except ValueError as e:
+                # same pytree gate as the swap path: veto, don't retry
+                self._vetoed.add(version)
+                self._log(f"registry: cannot stage shadow v{version}: {e}")
+                out.update(action="error", error=f"shadow v{version}: {e}")
+                return out
+            thr = svc.cfg.threshold
+        else:
+            thr = None
+        self._stats = make_stats(self.cfg, threshold=thr)
+        self._shadow_version = version
+        self._log(f"registry: shadow candidate v{version} staged "
+                  f"(live v{self._version})")
+        out.update(action="shadow_start", shadow=version)
+        return out
+
+    def _retire_shadow(self) -> None:
+        self._shadow_version = None
+        self._stats = None
+        if self._service is not None:
+            self._service.stop_shadow()
+
+    def shadow_report(self) -> Optional[dict]:
+        stats, version = self._stats, self._shadow_version
+        if stats is None or version is None:
+            return None
+        verdict, reason = evaluate(stats, self.cfg)
+        return {"shadow": version, "verdict": verdict, "reason": reason,
+                **stats.snapshot()}
+
+    # -- poll thread ----------------------------------------------------------
+
+    def start_polling(self) -> "ModelManager":
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+
+        def loop() -> None:
+            while not self._stop.wait(timeout=self.cfg.poll_sec):
+                try:
+                    self.poll()
+                except Exception as e:  # noqa: BLE001 — a poll failure
+                    # must never kill the lifecycle thread (the next poll
+                    # may find a repaired registry)
+                    self._log(f"registry poll failed: {type(e).__name__}: {e}")
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="nerrf-registry-poll")
+        self._thread.start()
+        return self
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None

@@ -37,6 +37,9 @@ class WindowAlert:
     t_admit: float
     t_scored: float
     late: bool
+    # registry model version that scored the window (None when the service
+    # runs without a model manager)
+    model_version: Optional[int] = None
 
 
 class AlertSink:

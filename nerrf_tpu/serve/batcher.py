@@ -82,6 +82,10 @@ class ScoredWindow:
     t_admit: float
     t_scored: float
     late: bool
+    # which registry model version scored this window (None without a
+    # model manager) — the per-window stamp the swap bench asserts flips
+    # at exactly one batch boundary
+    model_version: Optional[int] = None
 
 
 class MicroBatcher:
@@ -237,7 +241,13 @@ class MicroBatcher:
         try:
             with trace_span("serve_device_score", device=True, bucket=tag,
                             windows=len(reqs)):
-                probs = np.asarray(self._score_fn(batch))
+                out = self._score_fn(batch)
+                # a version-stamping score_fn (the registry-managed serve
+                # path) returns (probs, model_version); plain score_fns
+                # keep returning the bare array
+                probs, version = out if isinstance(out, tuple) \
+                    else (out, None)
+                probs = np.asarray(probs)
         except Exception as exc:  # noqa: BLE001 — one bad batch must not
             # kill the scorer thread and wedge every stream behind it
             self._reg.counter_inc(
@@ -265,7 +275,8 @@ class MicroBatcher:
                     lo_ns=r.lo_ns, hi_ns=r.hi_ns, bucket=bucket,
                     probs=probs[j], node_type=s["node_type"],
                     node_key=s["node_key"], node_mask=s["node_mask"],
-                    t_admit=r.t_admit, t_scored=now, late=late))
+                    t_admit=r.t_admit, t_scored=now, late=late,
+                    model_version=version))
                 r.sample = None  # release the padded sample's memory
             self._reg.counter_inc(
                 "serve_windows_scored_total", len(reqs),

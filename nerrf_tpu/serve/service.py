@@ -115,21 +115,131 @@ class OnlineDetectionService:
         self._warm = False
         self._admission_open = False
         self.warmup_seconds: Dict[str, float] = {}
+        # model lifecycle state (nerrf_tpu/registry): the live param
+        # pointer is swapped atomically under _swap_lock between batch
+        # closes; a staged shadow candidate scores the same batches
+        self._swap_lock = threading.Lock()
+        self._live_version: Optional[int] = None
+        self._shadow: Optional[Tuple[object, int]] = None
+        self._manager = None
+        # the operating point the service booted with: a swap to an
+        # UNCALIBRATED version restores this instead of leaking the
+        # outgoing version's calibrated cut
+        self._boot_threshold = self.cfg.threshold
         # optional per-window SLO log: every scored window appends
-        # (stream, window_idx, latency_sec, late) — the registry histogram
-        # gives means, this gives exact percentiles (bench/SLO reporting)
+        # (stream, window_idx, latency_sec, late, model_version) — the
+        # registry histogram gives means, this gives exact percentiles and
+        # per-window version stamps (bench/SLO + swap-bench reporting)
         self._window_log = window_log
 
     # -- device program -------------------------------------------------------
 
-    def _score_fn(self, batch: Dict[str, np.ndarray]) -> np.ndarray:
+    def _score_fn(self, batch: Dict[str, np.ndarray]):
         """The shared device program: vmapped NerrfNet eval on one padded
         batch → host node probabilities.  Same jit (make_eval_fn), same
-        host-side sigmoid as model_detect — the parity path."""
+        host-side sigmoid as model_detect — the parity path.
+
+        The live param pointer is captured ONCE per batch (under the swap
+        lock), so every window of a batch is scored by exactly one model
+        version and a concurrent hot-swap lands at a batch boundary.
+        Returns ``(probs, model_version)``; the batcher stamps the version
+        into every demuxed window."""
         import jax
 
-        out = jax.device_get(self._eval_fn(self._params, batch))
-        return 1.0 / (1.0 + np.exp(-out["node_logit"]))
+        with self._swap_lock:
+            params = self._params
+            version = self._live_version
+            shadow = self._shadow
+        out = jax.device_get(self._eval_fn(params, batch))
+        probs = 1.0 / (1.0 + np.exp(-out["node_logit"]))
+        if shadow is not None:
+            self._shadow_score(shadow, batch, probs)
+        return probs, version
+
+    def _shadow_score(self, shadow, batch, live_probs) -> None:
+        """Score the staged candidate against the SAME packed batch the
+        live model just scored (same program — only the params differ, so
+        no recompile) and feed the paired comparison to the manager.
+        Best-effort: a shadow failure must never cost a live window."""
+        import jax
+
+        s_params, s_version = shadow
+        try:
+            with trace_span("registry_shadow_score", device=True,
+                            version=s_version,
+                            windows=int(live_probs.shape[0])):
+                s_out = jax.device_get(self._eval_fn(s_params, batch))
+            s_probs = 1.0 / (1.0 + np.exp(-s_out["node_logit"]))
+            if self._manager is None:
+                return
+            mask = np.asarray(batch["node_mask"]).astype(bool)
+            for j in range(live_probs.shape[0]):
+                if mask[j].any():  # skip the batch's zero-padded tail slots
+                    self._manager.observe_shadow(
+                        live_probs[j], s_probs[j], mask[j], s_version)
+        except Exception as e:  # noqa: BLE001 — shadow is advisory
+            self._reg.counter_inc(
+                "registry_shadow_failures_total",
+                help="shadow-scoring attempts that raised (live scoring "
+                     "unaffected)")
+            if self._manager is not None:
+                self._manager._log(
+                    f"shadow score failed: {type(e).__name__}: {e}")
+
+    # -- model lifecycle (nerrf_tpu/registry) ---------------------------------
+
+    @property
+    def model_config(self):
+        """The architecture the compiled bucket programs encode."""
+        return self._model.cfg if self._model is not None else None
+
+    @property
+    def live_version(self) -> Optional[int]:
+        return self._live_version
+
+    def attach_manager(self, manager) -> None:
+        self._manager = manager
+
+    def swap_params(self, params, version: Optional[int] = None,
+                    threshold: Optional[float] = None) -> None:
+        """Zero-downtime hot-swap: validate the new pytree against the one
+        the bucket programs were compiled for, stage it to device, then
+        atomically repoint the live params.  No window is dropped (nothing
+        queued is touched) and no program recompiles (the jit cache keys on
+        shapes, which are unchanged by contract).  ``threshold`` moves the
+        alerting operating point with the weights when the new checkpoint
+        carries its own calibration; ``None`` (an uncalibrated version)
+        restores the boot-time operating point — rolling back to an
+        uncalibrated v1 must not keep serving at v2's calibrated cut."""
+        import dataclasses as _dc
+
+        import jax
+
+        _check_swap_compatible(self._params, params)
+        staged = jax.device_put(params)
+        jax.block_until_ready(staged)  # transfer cost lands OUTSIDE the lock
+        want_thr = threshold if threshold is not None else self._boot_threshold
+        with self._swap_lock:
+            self._params = staged
+            self._live_version = version
+            if want_thr != self.cfg.threshold:
+                self.cfg = _dc.replace(self.cfg, threshold=want_thr)
+
+    def start_shadow(self, params, version: int) -> None:
+        """Stage a candidate: from the next batch on, every live batch is
+        also scored by these params (results never reach alerts/streams —
+        only the paired guardrail statistics)."""
+        import jax
+
+        _check_swap_compatible(self._params, params)
+        staged = jax.device_put(params)
+        jax.block_until_ready(staged)
+        with self._swap_lock:
+            self._shadow = (staged, int(version))
+
+    def stop_shadow(self) -> None:
+        with self._swap_lock:
+            self._shadow = None
 
     def _warmup(self, log=None) -> None:
         """Compile the eval program for every configured bucket (the
@@ -165,13 +275,23 @@ class OnlineDetectionService:
         self._admission_open = True
         return self
 
-    def ready(self) -> Tuple[bool, str]:
-        """Readiness (the /readyz contract): warmed AND admitting."""
+    def ready(self):
+        """Readiness (the /readyz contract): warmed AND admitting.  The
+        third element is extra payload for the probe body — the live model
+        version, so probes and dashboards can see WHICH model is serving
+        without scraping metrics."""
+        extra = {"model_version": (f"v{self._live_version}"
+                                   if self._live_version is not None
+                                   else None)}
+        if self._manager is not None:
+            extra["lineage"] = self._manager.lineage
+            if self._manager.shadow_version is not None:
+                extra["shadow_version"] = f"v{self._manager.shadow_version}"
         if not self._warm:
-            return False, "warmup in progress"
+            return False, "warmup in progress", extra
         if not self._admission_open:
-            return False, "admission closed"
-        return True, "ok"
+            return False, "admission closed", extra
+        return True, "ok", extra
 
     def stop(self, drain: bool = True) -> None:
         self._admission_open = False
@@ -386,7 +506,8 @@ class OnlineDetectionService:
         for s in scored:
             if self._window_log is not None:
                 self._window_log.append(
-                    (s.stream, s.window_idx, s.t_scored - s.t_admit, s.late))
+                    (s.stream, s.window_idx, s.t_scored - s.t_admit, s.late,
+                     s.model_version))
             with self._lock:
                 handle = self._streams.get(s.stream)
             if handle is not None:
@@ -409,7 +530,8 @@ class OnlineDetectionService:
                 stream=s.stream, window_idx=s.window_idx,
                 lo_ns=s.lo_ns, hi_ns=s.hi_ns,
                 max_prob=float(s.probs[mask].max()), hot=hot,
-                t_admit=s.t_admit, t_scored=s.t_scored, late=s.late))
+                t_admit=s.t_admit, t_scored=s.t_scored, late=s.late,
+                model_version=s.model_version))
 
     def _on_failed(self, reqs: List[WindowRequest], exc: BaseException) -> None:
         for r in reqs:
@@ -428,9 +550,15 @@ class OnlineDetectionService:
     # -- finalize -------------------------------------------------------------
 
     def _finalize(self, handle: StreamHandle) -> DetectionResult:
+        # stamp the scoring model: one version for the whole stream →
+        # "serve[agg]@vN"; mixed (scored across a hot-swap) or unmanaged
+        # (no registry) → the plain tag
+        versions = {s.model_version for s in handle.scored}
+        detector = f"serve[{self.cfg.agg}]"
+        if len(versions) == 1 and None not in versions:
+            detector += f"@v{versions.pop()}"
         if handle.windower.strings is None:  # stream never produced events
-            return DetectionResult({}, {}, {},
-                                   detector=f"serve[{self.cfg.agg}]")
+            return DetectionResult({}, {}, {}, detector=detector)
         trace = handle.windower.trace(name=handle.id)
         ino_path = _inode_to_path(trace)
         pid_comm = _pid_to_comm(trace)
@@ -445,8 +573,36 @@ class OnlineDetectionService:
         return finalize_detection(trace, window_scores, proc_scores,
                                   agg=self.cfg.agg,
                                   threshold=self.cfg.threshold,
-                                  detector=f"serve[{self.cfg.agg}]",
+                                  detector=detector,
                                   ino_path=ino_path)
+
+
+def _check_swap_compatible(current, incoming) -> None:
+    """The swap gate: the incoming pytree must match the live one in
+    structure and per-leaf shape/dtype — the precondition for the swap to
+    reuse every compiled bucket program (jit caches key on avals, so an
+    identical signature can never trigger a recompile)."""
+    import jax
+
+    cur_leaves, cur_def = jax.tree_util.tree_flatten(current)
+    new_leaves, new_def = jax.tree_util.tree_flatten(incoming)
+    if cur_def != new_def:
+        raise ValueError(
+            f"cannot hot-swap: param tree structure changed "
+            f"({cur_def} != {new_def}) — retrain/republish at the serving "
+            f"architecture or restart the service")
+    def sig(leaf):
+        # attribute access, not np.asarray: no device→host copy per leaf
+        return (tuple(getattr(leaf, "shape", ())),
+                str(getattr(leaf, "dtype", type(leaf).__name__)))
+
+    for i, (c, n) in enumerate(zip(cur_leaves, new_leaves)):
+        c_sig, n_sig = sig(c), sig(n)
+        if c_sig != n_sig:
+            raise ValueError(
+                f"cannot hot-swap: param leaf {i} is {n_sig}, the compiled "
+                f"programs expect {c_sig} — the checkpoint was trained at a "
+                f"different architecture")
 
 
 def _tiny_trace(name: str) -> Trace:

@@ -8,8 +8,11 @@ the model config, so a checkpoint is self-describing and `nerrf undo
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import json
+import os
+import shutil
 from pathlib import Path
 from typing import Tuple
 
@@ -32,6 +35,60 @@ SCHEMA_VERSION = 2
 # just SCHEMA_VERSION) when a change means older checkpoints must not load
 # silently — only a floor can actually reject them
 MIN_SCHEMA_VERSION = 2
+
+
+@contextlib.contextmanager
+def _atomic_dir(path: Path):
+    """Write-temp-then-rename checkpoint publish.
+
+    The body saves into a sibling temp directory; only a *complete* save is
+    renamed into place (rename(2) is atomic on one filesystem), so a
+    concurrent reader — the model registry's poll loop, a serve pod's
+    loader — can never observe a torn checkpoint directory: it sees the old
+    complete checkpoint, the new complete checkpoint, or nothing.  A crash
+    mid-save leaves the temp directory behind (reclaimed by the next save
+    to the same path) and the previous checkpoint recoverable: a crash in
+    the narrow window between the two final renames parks it at
+    ``.<name>.old``, which the next save renames back before starting."""
+    path = Path(path).absolute()
+    tmp = path.parent / f".{path.name}.tmp"
+    old = path.parent / f".{path.name}.old"
+    if not path.exists() and old.exists():
+        # crashed between the two renames last time: the parked previous
+        # checkpoint is the only good copy — restore it, never discard it
+        os.rename(old, path)
+    for leftover in (tmp, old):
+        if leftover.exists():
+            shutil.rmtree(leftover)
+    tmp.mkdir(parents=True)
+    try:
+        yield tmp
+    except BaseException:
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
+    # swap: park the previous checkpoint, rename the new one in, then
+    # reclaim — both renames are atomic, so no reader ever sees a mix
+    if path.exists():
+        os.rename(path, old)
+    os.rename(tmp, path)
+    shutil.rmtree(old, ignore_errors=True)
+
+
+def _read_sidecar(path: Path, name: str) -> dict:
+    """The checkpoint's JSON sidecar, with the two corruption modes turned
+    into one-line actionable errors instead of a raw KeyError/JSONDecodeError
+    surfacing deep inside the loader."""
+    f = path / name
+    try:
+        return json.loads(f.read_text())
+    except FileNotFoundError:
+        raise FileNotFoundError(
+            f"not a checkpoint: {path} has no {name} sidecar (wrong "
+            f"directory, a torn copy, or a save that never finished)"
+        ) from None
+    except json.JSONDecodeError as e:
+        raise ValueError(
+            f"corrupt checkpoint sidecar {f}: not valid JSON ({e})") from None
 
 
 def _check_schema_version(meta: dict, path: Path) -> None:
@@ -82,11 +139,6 @@ def _check_feature_layout(meta: dict, path: Path, keys: tuple) -> None:
 
 def save_checkpoint(path: str | Path, params, cfg: JointConfig,
                     calibration: dict | None = None) -> None:
-    path = Path(path).absolute()
-    path.mkdir(parents=True, exist_ok=True)
-    with trace_span("checkpoint", kind="params"):
-        with ocp.StandardCheckpointer() as ckptr:
-            ckptr.save(path / "params", jax.device_get(params), force=True)
     meta = {
         "gnn": {"hidden": cfg.gnn.hidden, "num_layers": cfg.gnn.num_layers,
                 "dropout": cfg.gnn.dropout,
@@ -103,19 +155,28 @@ def save_checkpoint(path: str | Path, params, cfg: JointConfig,
         # belong WITH the weights: a checkpoint evaluated at someone else's
         # threshold silently changes its false-positive behavior
         meta["calibration"] = calibration
-    (path / "model_config.json").write_text(json.dumps(meta, indent=2))
+    with _atomic_dir(path) as tmp:
+        with trace_span("checkpoint", kind="params"):
+            with ocp.StandardCheckpointer() as ckptr:
+                ckptr.save(tmp / "params", jax.device_get(params), force=True)
+        (tmp / "model_config.json").write_text(json.dumps(meta, indent=2))
 
 
 def load_checkpoint(path: str | Path) -> Tuple[dict, JointConfig]:
     path = Path(path).absolute()
-    meta = json.loads((path / "model_config.json").read_text())
+    meta = _read_sidecar(path, "model_config.json")
     _check_schema_version(meta, path)
     _check_feature_layout(meta, path, keys=("node", "edge", "seq"))
-    cfg = JointConfig(
-        gnn=GraphSAGEConfig(**meta["gnn"]),
-        lstm=LSTMConfig(**meta["lstm"]),
-        fuse=meta["fuse"],
-    )
+    try:
+        cfg = JointConfig(
+            gnn=GraphSAGEConfig(**meta["gnn"]),
+            lstm=LSTMConfig(**meta["lstm"]),
+            fuse=meta["fuse"],
+        )
+    except (KeyError, TypeError) as e:
+        raise ValueError(
+            f"corrupt checkpoint sidecar {path / 'model_config.json'}: "
+            f"missing or malformed model-config field ({e!r})") from None
     with ocp.StandardCheckpointer() as ckptr:
         params = ckptr.restore(path / "params")
     return params, cfg
@@ -125,9 +186,8 @@ def load_calibration(path: str | Path) -> dict:
     """The checkpoint's held-out-calibrated operating points ({} when the
     checkpoint predates calibration).  Separate from load_checkpoint so its
     two-tuple contract stays stable for existing callers."""
-    meta = json.loads((Path(path).absolute() / "model_config.json")
-                      .read_text())
-    return meta.get("calibration") or {}
+    return _read_sidecar(Path(path).absolute(),
+                         "model_config.json").get("calibration") or {}
 
 
 def save_stream_checkpoint(path: str | Path, params, cfg,
@@ -148,10 +208,6 @@ def save_stream_checkpoint(path: str | Path, params, cfg,
     producer in this repo writes)."""
     import jax.numpy as jnp
 
-    path = Path(path).absolute()
-    path.mkdir(parents=True, exist_ok=True)
-    with ocp.StandardCheckpointer() as ckptr:
-        ckptr.save(path / "params", jax.device_get(params), force=True)
     from nerrf_tpu.data.stream import STREAM_FEATURE_DIM
     meta = {
         "stream": {"dim": cfg.dim, "num_heads": cfg.num_heads,
@@ -166,7 +222,10 @@ def save_stream_checkpoint(path: str | Path, params, cfg,
             calibration = {"stream_event_threshold_space": "logit",
                            **calibration}
         meta["calibration"] = calibration
-    (path / "stream_config.json").write_text(json.dumps(meta, indent=2))
+    with _atomic_dir(path) as tmp:
+        with ocp.StandardCheckpointer() as ckptr:
+            ckptr.save(tmp / "params", jax.device_get(params), force=True)
+        (tmp / "stream_config.json").write_text(json.dumps(meta, indent=2))
 
 
 def load_stream_checkpoint(path: str | Path):
@@ -176,7 +235,7 @@ def load_stream_checkpoint(path: str | Path):
     from nerrf_tpu.models import StreamConfig
 
     path = Path(path).absolute()
-    meta = json.loads((path / "stream_config.json").read_text())
+    meta = _read_sidecar(path, "stream_config.json")
     _check_schema_version(meta, path)
     from nerrf_tpu.data.stream import STREAM_FEATURE_DIM
     got = (meta.get("features") or {}).get("stream")
@@ -185,6 +244,10 @@ def load_stream_checkpoint(path: str | Path):
             f"retrain: feature layout changed — stream checkpoint {path} "
             f"was trained with {got}-dim event features, current code "
             f"produces {STREAM_FEATURE_DIM}")
+    if "stream" not in meta:
+        raise ValueError(
+            f"corrupt checkpoint sidecar {path / 'stream_config.json'}: "
+            f"missing the 'stream' model-config field")
     s = dict(meta["stream"])
     s["dtype"] = jnp.dtype(s["dtype"]).type
     cfg = StreamConfig(**s)

@@ -33,7 +33,9 @@ def _log(msg: str) -> None:
 def run_experiment(name_or_path: str, out_dir: str | Path,
                    num_steps: int | None = None,
                    ckpt_every: int = 0, sharded: bool | None = None,
-                   calibrate: bool = True) -> dict:
+                   calibrate: bool = True,
+                   publish_to: str | None = None,
+                   lineage: str = "default") -> dict:
     import dataclasses
 
     import jax
@@ -91,7 +93,8 @@ def run_experiment(name_or_path: str, out_dir: str | Path,
                 "corpus_eval_windows": int(sc.manifest["eval_windows"]),
             }
             return _finish(exp, cfg, out, n_dev, metrics, steps_per_sec,
-                           params, t0, corpus_extra, calibrate=calibrate)
+                           params, t0, corpus_extra, calibrate=calibrate,
+                           publish_to=publish_to, lineage=lineage)
         _log(f"corpus_dir {cdir} not generated "
              f"(python scripts/gen_corpus.py --out {cdir}) — falling back "
              f"to the in-memory corpus "
@@ -166,11 +169,14 @@ def run_experiment(name_or_path: str, out_dir: str | Path,
             res.metrics, res.steps_per_sec, res.state.params)
 
     return _finish(exp, cfg, out, n_dev, metrics, steps_per_sec, params, t0,
-                   corpus_extra, calibrate=calibrate)
+                   corpus_extra, calibrate=calibrate,
+                   publish_to=publish_to, lineage=lineage)
 
 
 def _finish(exp, cfg, out: Path, n_dev, metrics, steps_per_sec, params,
-            t0, extra, calibrate: bool = True) -> dict:
+            t0, extra, calibrate: bool = True,
+            publish_to: str | None = None,
+            lineage: str = "default") -> dict:
     import jax
 
     from nerrf_tpu.train.checkpoint import save_checkpoint
@@ -191,6 +197,29 @@ def _finish(exp, cfg, out: Path, n_dev, metrics, steps_per_sec, params,
                                         node_loss_weight=cfg.node_loss_weight,
                                         log=_log)
                    if calibrate else None)
+    published = None
+    if publish_to and jax.process_count() != 1:
+        # multi-controller: every process would race to publish the same
+        # version; say so instead of silently dropping the request
+        _log(f"registry publish skipped on a {jax.process_count()}-process "
+             f"run — publish the checkpoint from one host: nerrf models "
+             f"publish --registry {publish_to} --model-dir {out / 'model'}")
+    elif publish_to:
+        # the publish hook runs AFTER calibrate_and_resave so the version
+        # carries its operating threshold; best-effort — a registry failure
+        # must never lose a finished training run (the checkpoint is
+        # already safe under --out)
+        try:
+            from nerrf_tpu.registry import ModelRegistry
+
+            published = ModelRegistry(publish_to).publish(
+                lineage, out / "model",
+                source=f"nerrf_tpu.train.run --experiment {exp.name}")
+            _log(f"published {out / 'model'} as {lineage}/v{published} "
+                 f"in {publish_to}")
+        except Exception as e:  # noqa: BLE001
+            _log(f"registry publish failed ({type(e).__name__}: {e}); "
+                 f"checkpoint remains at {out / 'model'}")
     report = {
         "experiment": exp.name,
         "backend": jax.default_backend(),
@@ -210,6 +239,7 @@ def _finish(exp, cfg, out: Path, n_dev, metrics, steps_per_sec, params,
                if cfg.seq_loss_weight > 0 else {}),
         },
         "wall_seconds": round(time.time() - t0, 1),
+        **({"published_version": published} if published else {}),
         **extra,
     }
     (out / "metrics.json").write_text(json.dumps(report, indent=2) + "\n")
@@ -230,6 +260,11 @@ def main(argv=None) -> int:
                     help="force a JAX platform (e.g. 'cpu') before backend "
                          "init — env vars can't override the axon "
                          "sitecustomize on this host, jax.config can")
+    ap.add_argument("--publish", default=None, metavar="REGISTRY",
+                    help="publish the calibrated checkpoint into this model "
+                         "registry after training (see docs/model-lifecycle.md)")
+    ap.add_argument("--lineage", default="default",
+                    help="registry lineage to publish into (with --publish)")
     args = ap.parse_args(argv)
     # Multi-host: join the cluster BEFORE any backend use.  Set
     # NERRF_COORDINATOR/NERRF_NUM_PROCESSES/NERRF_PROCESS_ID per process
@@ -272,7 +307,8 @@ def main(argv=None) -> int:
         _log(f"distributed: process {jax.process_index()}/"
              f"{jax.process_count()}, {jax.device_count()} global devices")
     report = run_experiment(args.experiment, args.out, args.steps,
-                            args.ckpt_every)
+                            args.ckpt_every, publish_to=args.publish,
+                            lineage=args.lineage)
     return 0 if all(report["gates"].values()) else 1
 
 

@@ -30,6 +30,23 @@ from pathlib import Path
 REPO = Path(__file__).resolve().parents[1]
 SCAN = ("nerrf_tpu", "bench.py", "benchmarks")
 
+# Contract metrics: names dashboards/alerts/docs depend on, which must
+# keep being registered SOMEWHERE in the codebase — deleting the last call
+# site would silently blank a dashboard panel.  (The model-lifecycle set
+# rides the registry subsystem: docs/model-lifecycle.md's runbook keys off
+# these exact names.)
+REQUIRED = (
+    "model_info",
+    "registry_swaps_total",
+    "registry_shadow_windows_total",
+    "registry_shadow_disagreement_rate",
+    "registry_shadow_score_drift",
+    "registry_shadow_vetoes_total",
+    "registry_promotions_total",
+    "serve_windows_scored_total",
+    "serve_recompiles_total",
+)
+
 _CALL = re.compile(
     r"\.(counter_inc|gauge_set|histogram_observe)\(\s*"
     r"(?:['\"](?P<lit>[A-Za-z0-9_:]+)['\"]|(?P<const>[A-Z][A-Z0-9_]*))")
@@ -107,6 +124,13 @@ def lint(metrics: dict[str, dict]) -> list[str]:
     return errors
 
 
+def check_required(metrics: dict[str, dict],
+                   required=REQUIRED) -> list[str]:
+    return [f"contract metric {name!r} is no longer registered anywhere "
+            f"(a dashboard/runbook depends on it)"
+            for name in required if name not in metrics]
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--list", action="store_true",
@@ -119,7 +143,7 @@ def main(argv=None) -> int:
             print(f"{name:<36} {types:<10} "
                   f"{'help' if rec['has_help'] else 'NO HELP':<8} "
                   f"{len(rec['sites'])} site(s)")
-    errors = lint(metrics)
+    errors = lint(metrics) + check_required(metrics)
     for e in errors:
         print(f"check_metrics: {e}", file=sys.stderr)
     if not errors:

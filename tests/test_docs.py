@@ -23,7 +23,8 @@ def test_referenced_cli_commands_exist(repo_root):
     text = "".join(p.read_text() for p in pages)
     referenced = set(re.findall(r"nerrf_tpu\.cli (\w[\w-]*)", text))
     parser_cmds = {"simulate", "train-detector", "undo", "status", "serve",
-                   "serve-detect", "ingest", "trace", "warmup", "doctor"}
+                   "serve-detect", "ingest", "trace", "warmup", "doctor",
+                   "models"}
     assert referenced <= parser_cmds
     # and the parser really accepts them
     for cmd in parser_cmds:

@@ -43,3 +43,17 @@ def test_lint_rules_fire_on_violations(tmp_path, repo_root):
     # UPPER_CASE constant names resolve to their literal in the same file
     assert "const_backed_total" in metrics
     assert not [e for e in errors if "const_backed_total" in e]
+
+
+def test_contract_metrics_stay_registered(repo_root):
+    """The model-lifecycle + serve contract names (dashboards/runbooks key
+    off them) are still registered somewhere, and removing one fires the
+    required-names check."""
+    cm = _load_check_metrics(repo_root)
+    metrics = cm.scan()
+    assert cm.check_required(metrics) == []
+    for name in ("model_info", "registry_swaps_total",
+                 "registry_shadow_disagreement_rate"):
+        assert name in metrics, f"contract metric {name} not registered"
+    missing = cm.check_required({}, required=("model_info",))
+    assert missing and "model_info" in missing[0]

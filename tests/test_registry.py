@@ -1,0 +1,585 @@
+"""Model lifecycle registry: store invariants (atomic publish/promote,
+concurrent publish safety, rollback repoint), shadow disagreement math,
+guardrail verdicts, the deterministic in-process hot-swap, and the
+checkpoint atomicity/corruption satellites it builds on.
+
+Everything here runs with a FAKE score function reading the service's
+live param pointer — the swap/shadow mechanics are model-free by design;
+the compiled-model parity across a real swap is the swap bench's job
+(benchmarks/run_swap_bench.py, smoke-run from bench.py)."""
+
+import dataclasses
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from nerrf_tpu.models import GraphSAGEConfig, JointConfig, LSTMConfig
+from nerrf_tpu.observability import MetricsRegistry
+from nerrf_tpu.registry import (
+    PROMOTE,
+    VETO,
+    WAIT,
+    ModelManager,
+    ModelRegistry,
+    RegistryConfig,
+    evaluate,
+    make_stats,
+)
+from nerrf_tpu.serve import MicroBatcher, OnlineDetectionService, ServeConfig
+
+BUCKET = (256, 512, 64)
+
+
+def _leaf_params(value: float):
+    """A tiny param pytree whose single leaf encodes the 'model': the fake
+    score function scores every node with it, so scores prove which
+    version scored a window."""
+    return {"dense": {"w": np.full((2, 2), value, np.float32)}}
+
+
+@pytest.fixture()
+def ckpt_dir(tmp_path):
+    """A real (tiny) checkpoint directory via the real saver."""
+    from nerrf_tpu.train.checkpoint import save_checkpoint
+
+    path = tmp_path / "ckpt"
+    save_checkpoint(path, _leaf_params(0.25), JointConfig().small,
+                    calibration={"node_threshold": 0.42})
+    return path
+
+
+# -- checkpoint atomicity + corruption satellites -----------------------------
+
+def test_save_checkpoint_is_atomic_under_crash(tmp_path, monkeypatch):
+    """A crash mid-save must leave the previous checkpoint fully intact
+    and no half-written directory at the target path."""
+    from nerrf_tpu.train import checkpoint as ck
+
+    path = tmp_path / "model"
+    ck.save_checkpoint(path, _leaf_params(1.0), JointConfig().small)
+    before = json.loads((path / "model_config.json").read_text())
+
+    real_write = ck.Path.write_text
+
+    def crashing_write(self, *a, **kw):
+        if self.name == "model_config.json":
+            raise OSError("disk full mid-sidecar")
+        return real_write(self, *a, **kw)
+
+    monkeypatch.setattr(ck.Path, "write_text", crashing_write)
+    with pytest.raises(OSError):
+        ck.save_checkpoint(path, _leaf_params(2.0), JointConfig().small)
+    monkeypatch.undo()
+    # the OLD checkpoint is still complete and loadable
+    params, cfg = ck.load_checkpoint(path)
+    assert float(np.asarray(params["dense"]["w"]).ravel()[0]) == 1.0
+    assert json.loads((path / "model_config.json").read_text()) == before
+    # and no torn temp dir was left where a watcher would find it
+    assert not (tmp_path / ".model.tmp").exists()
+    # the next save over the survivor still works
+    ck.save_checkpoint(path, _leaf_params(3.0), JointConfig().small)
+    params, _ = ck.load_checkpoint(path)
+    assert float(np.asarray(params["dense"]["w"]).ravel()[0]) == 3.0
+
+
+def test_save_checkpoint_recovers_parked_previous_after_rename_crash(tmp_path):
+    """A crash in the window between the two final renames parks the only
+    good checkpoint at .<name>.old; the next save must recover it (never
+    rmtree it) before starting."""
+    import os
+
+    from nerrf_tpu.train import checkpoint as ck
+
+    path = tmp_path / "model"
+    ck.save_checkpoint(path, _leaf_params(1.0), JointConfig().small)
+    # simulate the crash state: path renamed away, new tmp never landed
+    os.rename(path, tmp_path / ".model.old")
+    assert not path.exists()
+    ck.save_checkpoint(path, _leaf_params(2.0), JointConfig().small)
+    params, _ = ck.load_checkpoint(path)
+    assert float(np.asarray(params["dense"]["w"]).ravel()[0]) == 2.0
+    assert not (tmp_path / ".model.old").exists()
+
+
+def test_load_checkpoint_corrupt_and_missing_sidecar_error_clearly(tmp_path):
+    from nerrf_tpu.train.checkpoint import (
+        load_calibration,
+        load_checkpoint,
+        save_checkpoint,
+    )
+
+    # missing sidecar (empty dir): one clear line, not a raw FileNotFound
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    with pytest.raises(FileNotFoundError, match="not a checkpoint"):
+        load_checkpoint(empty)
+    with pytest.raises(FileNotFoundError, match="not a checkpoint"):
+        load_calibration(empty)
+
+    # corrupt JSON
+    bad = tmp_path / "bad"
+    bad.mkdir()
+    (bad / "model_config.json").write_text("{not json")
+    with pytest.raises(ValueError, match="corrupt checkpoint sidecar"):
+        load_checkpoint(bad)
+
+    # missing meta key (the old raw-KeyError path)
+    torn = tmp_path / "torn"
+    save_checkpoint(torn, _leaf_params(1.0), JointConfig().small)
+    meta = json.loads((torn / "model_config.json").read_text())
+    del meta["lstm"]
+    (torn / "model_config.json").write_text(json.dumps(meta))
+    with pytest.raises(ValueError, match="missing or malformed"):
+        load_checkpoint(torn)
+
+
+# -- store: publish / promote / rollback --------------------------------------
+
+def test_store_publish_promote_rollback_roundtrip(tmp_path, ckpt_dir):
+    reg = ModelRegistry(tmp_path / "registry")
+    assert reg.versions("det") == []
+    assert reg.live_version("det") is None
+    v1 = reg.publish("det", ckpt_dir, source="test")
+    v2 = reg.publish("det", ckpt_dir)
+    assert (v1, v2) == (1, 2)
+    assert reg.versions("det") == [1, 2]
+    # publish never touches LIVE
+    assert reg.live_version("det") is None
+    reg.promote("det", v1)
+    assert reg.live_version("det") == 1
+    reg.promote("det", v2)
+    live = reg.live("det")
+    assert live["version"] == 2 and live["previous"] == 1
+    # one-command rollback repoints at the recorded previous
+    rec = reg.rollback("det")
+    assert rec["version"] == 1 and rec["kind"] == "rollback"
+    assert reg.live_version("det") == 1
+    # the rolled-past version directory is untouched (post-mortem material)
+    assert (reg.version_dir("det", 2) / "model_config.json").exists()
+    params, cfg, calib, ver = reg.load("det")
+    assert ver == 1 and calib["node_threshold"] == 0.42
+    st = reg.status("det")
+    assert [v["version"] for v in st["versions"]] == [1, 2]
+    assert [v["live"] for v in st["versions"]] == [True, False]
+
+
+def test_store_publish_gates_bad_checkpoints(tmp_path, ckpt_dir):
+    reg = ModelRegistry(tmp_path / "registry")
+    # feature-layout drift is rejected at PUBLISH, not discovered at apply
+    meta = json.loads((ckpt_dir / "model_config.json").read_text())
+    meta["features"]["node"] = 999
+    (ckpt_dir / "model_config.json").write_text(json.dumps(meta))
+    with pytest.raises(ValueError, match="feature layout changed"):
+        reg.publish("det", ckpt_dir)
+    assert reg.versions("det") == []  # nothing half-published
+    # promoting a version that does not exist is refused
+    with pytest.raises(FileNotFoundError, match="no v7"):
+        reg.promote("det", 7)
+
+
+def test_store_concurrent_publish_yields_distinct_versions(tmp_path, ckpt_dir):
+    reg = ModelRegistry(tmp_path / "registry")
+    versions, errors = [], []
+
+    def worker():
+        try:
+            versions.append(reg.publish("det", ckpt_dir))
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker) for _ in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert not errors
+    assert sorted(versions) == [1, 2, 3, 4, 5, 6]
+    assert reg.versions("det") == [1, 2, 3, 4, 5, 6]
+    for v in versions:
+        assert (reg.version_dir("det", v) / "model_config.json").exists()
+
+
+# -- guardrails: disagreement math + verdicts ---------------------------------
+
+def test_shadow_stats_disagreement_and_drift_math():
+    cfg = RegistryConfig(shadow_min_windows=2, canary_windows=2)
+    stats = make_stats(cfg, threshold=0.5)
+    mask = np.array([True, True, True, True, False])
+    live = np.array([0.9, 0.1, 0.6, 0.4, 0.99])
+    # two of four real nodes flip across 0.5; padded slot ignored
+    shad = np.array([0.8, 0.2, 0.4, 0.6, 0.01])
+    stats.observe(live, shad, mask)
+    assert stats.disagreement_rate == pytest.approx(0.5)
+    # (0.1 + 0.1 + 0.2 + 0.2) / 4 real nodes
+    assert stats.score_drift == pytest.approx(0.15)
+    stats.observe(live, live, mask)  # identical → no flips, no drift
+    assert stats.disagreement_rate == pytest.approx(0.25)
+    snap = stats.snapshot()
+    assert snap["windows"] == 2 and snap["nodes"] == 8
+    assert snap["recent_window_rates"] == [0.5, 0.0]
+
+
+def test_guardrail_verdicts_wait_promote_veto():
+    cfg = RegistryConfig(shadow_min_windows=3, canary_windows=2,
+                         max_disagreement_rate=0.1, max_score_drift=0.05,
+                         canary_max_disagreement=0.2)
+    mask = np.ones(10, bool)
+    agree = np.full(10, 0.9)
+
+    stats = make_stats(cfg)
+    verdict, reason = evaluate(stats, cfg)
+    assert verdict == WAIT and "0/3" in reason
+    for _ in range(3):
+        stats.observe(agree, agree, mask)
+    verdict, reason = evaluate(stats, cfg)
+    assert verdict == PROMOTE
+
+    # aggregate disagreement veto
+    stats = make_stats(cfg)
+    flipped = np.full(10, 0.1)
+    for _ in range(3):
+        stats.observe(agree, flipped, mask)
+    verdict, reason = evaluate(stats, cfg)
+    assert verdict == VETO and "disagreement" in reason
+
+    # drift veto: same decisions, distribution walked 0.3 toward the cut
+    stats = make_stats(cfg)
+    drifted = np.full(10, 0.6)
+    for _ in range(3):
+        stats.observe(agree, drifted, mask)
+    verdict, reason = evaluate(stats, cfg)
+    assert verdict == VETO and "drift" in reason
+
+    # canary veto: clean on average, one recent window diverges
+    cfg2 = RegistryConfig(shadow_min_windows=3, canary_windows=2,
+                          max_disagreement_rate=0.2, max_score_drift=1.0,
+                          canary_max_disagreement=0.25)
+    stats = make_stats(cfg2)
+    half_flip = np.concatenate([np.full(5, 0.1), np.full(5, 0.9)])
+    for _ in range(5):
+        stats.observe(agree, agree, mask)
+    stats.observe(agree, half_flip, mask)   # lands in the canary tail
+    verdict, reason = evaluate(stats, cfg2)
+    assert verdict == VETO and "canary" in reason
+
+
+# -- the in-process swap: deterministic, atomic, stamped ----------------------
+
+def _fake_swap_service(cfg, registry):
+    """A service whose device program reads the LIVE param pointer exactly
+    like the real _score_fn does (captured once per batch under the swap
+    lock) — covers swap atomicity, version stamping, and rollback without
+    compiling anything."""
+    svc = OnlineDetectionService.__new__(OnlineDetectionService)
+    svc.cfg = cfg
+    svc._params = _leaf_params(0.25)
+    svc._model = None
+    svc._reg = registry
+    from nerrf_tpu.serve.alerts import AlertSink
+
+    svc.sink = AlertSink(cfg.alert_queue_slots, registry=registry)
+    svc._swap_lock = threading.Lock()
+    svc._live_version = 1
+    svc._shadow = None
+    svc._manager = None
+    svc._window_log = None
+    svc._boot_threshold = cfg.threshold
+
+    def score(batch):
+        with svc._swap_lock:
+            params = svc._params
+            version = svc._live_version
+            shadow = svc._shadow
+        value = float(np.asarray(params["dense"]["w"]).ravel()[0])
+        probs = np.full(batch["node_mask"].shape, value, np.float64)
+        if shadow is not None and svc._manager is not None:
+            s_value = float(
+                np.asarray(shadow[0]["dense"]["w"]).ravel()[0])
+            s_probs = np.full(batch["node_mask"].shape, s_value, np.float64)
+            mask = np.asarray(batch["node_mask"]).astype(bool)
+            for j in range(probs.shape[0]):
+                if mask[j].any():
+                    svc._manager.observe_shadow(
+                        probs[j], s_probs[j], mask[j], shadow[1])
+        return probs, version
+
+    svc._batcher = MicroBatcher(score_fn=score, cfg=cfg, registry=registry,
+                                on_scored=svc._on_scored,
+                                on_failed=svc._on_failed)
+    svc._lock = threading.Lock()
+    svc._streams = {}
+    svc._warm = True
+    svc._admission_open = True
+    svc.warmup_seconds = {}
+    for b in cfg.buckets:
+        svc._batcher.mark_warm(b)
+    svc._batcher.start()
+    return svc
+
+
+def _feed_trace(svc, sid, seed=3, duration=60.0):
+    from nerrf_tpu.data.synth import SimConfig, simulate_trace
+
+    tr = simulate_trace(SimConfig(duration_sec=duration, attack=True,
+                                  attack_start_sec=duration / 3,
+                                  num_target_files=4, benign_rate_hz=6.0,
+                                  seed=seed))
+    ev = tr.events
+    svc.join(sid)
+    for i in range(0, len(ev), 200):
+        block = type(ev)(**{f.name: getattr(ev, f.name)[i:i + 200]
+                            for f in dataclasses.fields(ev)})
+        svc.feed(sid, block, tr.strings)
+    return svc.leave(sid, timeout=30.0)
+
+
+def test_swap_is_deterministic_and_stamps_versions(tmp_path, ckpt_dir):
+    """Every window scored before the swap carries v1 scores+stamp, every
+    window after carries v2 — and rollback restores v1 exactly."""
+    cfg = ServeConfig(buckets=(BUCKET,), batch_size=4, batch_close_sec=0.02,
+                      window_sec=10.0, stride_sec=5.0)
+    reg = MetricsRegistry(namespace="test")
+    svc = _fake_swap_service(cfg, reg)
+    try:
+        det1 = _feed_trace(svc, "before")
+        assert det1.detector == "serve[max]@v1"
+        assert set(det1.file_scores.values()) == {0.25}
+
+        svc.swap_params(_leaf_params(0.75), version=2)
+        det2 = _feed_trace(svc, "after")
+        assert det2.detector == "serve[max]@v2"
+        assert set(det2.file_scores.values()) == {0.75}
+        # same trace, same windows — only the model changed
+        assert det1.file_scores.keys() == det2.file_scores.keys()
+
+        # alerts carry the stamp too (0.75 >= default 0.5 cut)
+        alerts = svc.sink.drain()
+        assert alerts and all(a.model_version == 2 for a in alerts)
+
+        svc.swap_params(_leaf_params(0.25), version=1)  # rollback repoint
+        det3 = _feed_trace(svc, "rolled-back")
+        assert det3.detector == "serve[max]@v1"
+        assert det3.file_scores == det1.file_scores
+        assert det3.file_window_scores == det1.file_window_scores
+    finally:
+        svc.stop(drain=False)
+
+
+def test_swap_rejects_incompatible_pytrees():
+    cfg = ServeConfig(buckets=(BUCKET,), batch_size=4)
+    svc = _fake_swap_service(cfg, MetricsRegistry(namespace="test"))
+    try:
+        with pytest.raises(ValueError, match="tree structure"):
+            svc.swap_params({"other": np.zeros(3)}, version=2)
+        with pytest.raises(ValueError, match="compiled"):
+            svc.swap_params({"dense": {"w": np.zeros((3, 3), np.float32)}},
+                            version=2)
+        # the failed swaps changed nothing
+        assert svc.live_version == 1
+    finally:
+        svc.stop(drain=False)
+
+
+def test_swap_threshold_travels_and_rollback_restores_boot_cut():
+    """A calibrated version moves the operating point with the weights; a
+    swap to an UNCALIBRATED version restores the boot-time cut instead of
+    leaking the outgoing version's calibration."""
+    cfg = ServeConfig(buckets=(BUCKET,), batch_size=4)
+    svc = _fake_swap_service(cfg, MetricsRegistry(namespace="test"))
+    try:
+        assert svc.cfg.threshold is None  # the boot operating point
+        svc.swap_params(_leaf_params(0.5), version=2, threshold=0.9)
+        assert svc.cfg.threshold == 0.9
+        svc.swap_params(_leaf_params(0.25), version=1)  # uncalibrated v1
+        assert svc.cfg.threshold is None  # boot cut restored, not 0.9
+    finally:
+        svc.stop(drain=False)
+
+
+# -- manager: poll → shadow → auto-promote / veto → rollback ------------------
+
+def _manager_setup(tmp_path, svc, reg, **cfg_kw):
+    from nerrf_tpu.train.checkpoint import save_checkpoint
+
+    store = ModelRegistry(tmp_path / "registry")
+    for i, value in enumerate((0.25, 0.75), start=1):
+        ck = tmp_path / f"src{i}"
+        save_checkpoint(ck, _leaf_params(value), JointConfig().small)
+        store.publish("det", ck)
+    store.promote("det", 1)
+    kw = dict(poll_sec=60.0, shadow_min_windows=3, canary_windows=2)
+    kw.update(cfg_kw)
+    mgr = ModelManager(store, "det", cfg=RegistryConfig(**kw), registry=reg)
+    mgr._version = 1
+    # bypass model-architecture comparison (the fake service has no model)
+    mgr.attach(svc)
+    return store, mgr
+
+
+def test_manager_follows_promote_and_rollback_pointer(tmp_path):
+    cfg = ServeConfig(buckets=(BUCKET,), batch_size=4, batch_close_sec=0.02,
+                      window_sec=10.0, stride_sec=5.0)
+    reg = MetricsRegistry(namespace="test")
+    svc = _fake_swap_service(cfg, reg)
+    try:
+        store, mgr = _manager_setup(tmp_path, svc, reg, auto_promote=False)
+        assert reg.value("model_info",
+                         labels={"lineage": "det", "version": "v1"}) == 1.0
+        # v2 published but not promoted → staged as shadow, live unchanged
+        out = mgr.poll()
+        assert out["action"] == "shadow_start" and svc.live_version == 1
+        # manual promote (the `nerrf models promote` path) → hot-swap
+        store.promote("det", 2)
+        out = mgr.poll()
+        assert out["action"] == "swap" and out["direction"] == "forward"
+        assert svc.live_version == 2
+        assert svc._shadow is None  # promoted candidate retired as shadow
+        det = _feed_trace(svc, "v2")
+        assert set(det.file_scores.values()) == {0.75}
+        # `nerrf models rollback` → pointer back → swap back
+        store.rollback("det")
+        out = mgr.poll()
+        assert out["direction"] == "rollback" and svc.live_version == 1
+        det = _feed_trace(svc, "v1-again")
+        assert set(det.file_scores.values()) == {0.25}
+        assert reg.value("model_info",
+                         labels={"lineage": "det", "version": "v2"}) == 0.0
+        assert reg.value("model_info",
+                         labels={"lineage": "det", "version": "v1"}) == 1.0
+        assert reg.value("registry_swaps_total",
+                         labels={"lineage": "det",
+                                 "direction": "rollback"}) == 1.0
+        # the rolled-back-from version must never be re-staged (and so can
+        # never be silently re-promoted): not by this manager...
+        assert mgr.poll()["action"] == "none"
+        assert svc._shadow is None
+        # ...and not by a freshly restarted one either (empty in-memory
+        # veto set; the LIVE pointer's recorded predecessor is the floor)
+        mgr2 = ModelManager(store, "det",
+                            cfg=RegistryConfig(poll_sec=60.0),
+                            registry=MetricsRegistry(namespace="test2"))
+        mgr2._version = 1
+        mgr2.attach(svc)
+        assert mgr2.poll()["action"] == "none"
+        assert svc._shadow is None
+    finally:
+        mgr.close()
+        svc.stop(drain=False)
+
+
+def test_manager_shadow_auto_promotes_agreeing_candidate(tmp_path):
+    """A candidate that scores identically passes every guardrail: the
+    manager promotes it in the REGISTRY (LIVE repoints) and swaps."""
+    cfg = ServeConfig(buckets=(BUCKET,), batch_size=4, batch_close_sec=0.02,
+                      window_sec=10.0, stride_sec=5.0)
+    reg = MetricsRegistry(namespace="test")
+    svc = _fake_swap_service(cfg, reg)
+    try:
+        from nerrf_tpu.train.checkpoint import save_checkpoint
+
+        store = ModelRegistry(tmp_path / "registry")
+        for i in (1, 2):  # v2 has IDENTICAL params → zero disagreement
+            ck = tmp_path / f"src{i}"
+            save_checkpoint(ck, _leaf_params(0.25), JointConfig().small)
+            store.publish("det", ck)
+        store.promote("det", 1)
+        mgr = ModelManager(store, "det",
+                           cfg=RegistryConfig(poll_sec=60.0,
+                                              shadow_min_windows=3,
+                                              canary_windows=2),
+                           registry=reg)
+        mgr._version = 1
+        mgr.attach(svc)
+        assert mgr.poll()["action"] == "shadow_start"
+        _feed_trace(svc, "load")  # shadow observes every scored window
+        assert reg.value("registry_shadow_windows_total",
+                         labels={"lineage": "det"}) >= 3
+        out = mgr.poll()
+        assert out["action"] == "auto_promote"
+        assert store.live_version("det") == 2  # promoted IN THE REGISTRY
+        assert svc.live_version == 2
+        assert reg.value("registry_promotions_total",
+                         labels={"lineage": "det", "kind": "auto"}) == 1.0
+    finally:
+        mgr.close()
+        svc.stop(drain=False)
+
+
+def test_manager_vetoes_disagreeing_candidate_and_never_restages(tmp_path):
+    cfg = ServeConfig(buckets=(BUCKET,), batch_size=4, batch_close_sec=0.02,
+                      window_sec=10.0, stride_sec=5.0)
+    reg = MetricsRegistry(namespace="test")
+    svc = _fake_swap_service(cfg, reg)
+    try:
+        store, mgr = _manager_setup(tmp_path, svc, reg,
+                                    max_disagreement_rate=0.02)
+        assert mgr.poll()["action"] == "shadow_start"
+        _feed_trace(svc, "load")  # 0.25 vs 0.75 across the 0.5 cut: flips
+        out = mgr.poll()
+        assert out["action"] == "veto" and out["vetoed"] == 2
+        assert svc.live_version == 1          # live never changed
+        assert store.live_version("det") == 1  # registry never changed
+        assert svc._shadow is None             # candidate unstaged
+        assert reg.value("registry_shadow_vetoes_total",
+                         labels={"lineage": "det"}) == 1.0
+        # the vetoed version is remembered, not re-staged forever
+        assert mgr.poll()["action"] == "none"
+    finally:
+        mgr.close()
+        svc.stop(drain=False)
+
+
+# -- readiness payload --------------------------------------------------------
+
+def test_readyz_payload_carries_model_version(tmp_path):
+    import urllib.request
+
+    from nerrf_tpu.observability import MetricsServer
+
+    cfg = ServeConfig(buckets=(BUCKET,), batch_size=4)
+    reg = MetricsRegistry(namespace="test")
+    svc = _fake_swap_service(cfg, reg)
+    try:
+        ok, reason, extra = svc.ready()
+        assert ok and extra["model_version"] == "v1"
+        with MetricsServer(registry=reg, ready_check=svc.ready) as srv:
+            body = json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/readyz", timeout=5).read())
+        assert body["status"] == "ready"
+        assert body["model_version"] == "v1"
+    finally:
+        svc.stop(drain=False)
+
+
+# -- CLI ----------------------------------------------------------------------
+
+def test_cli_models_lifecycle_roundtrip(tmp_path, ckpt_dir, capsys):
+    import nerrf_tpu.cli as cli
+
+    regdir = str(tmp_path / "registry")
+    assert cli.main(["models", "publish", "--registry", regdir,
+                     "--lineage", "det", "--model-dir", str(ckpt_dir),
+                     "--promote"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["published"] == 1 and out["live"]["version"] == 1
+    assert cli.main(["models", "publish", "--registry", regdir,
+                     "--lineage", "det", "--model-dir", str(ckpt_dir)]) == 0
+    capsys.readouterr()
+    assert cli.main(["models", "promote", "--registry", regdir,
+                     "--lineage", "det", "--version", "2"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["live"]["version"] == 2
+    assert cli.main(["models", "rollback", "--registry", regdir,
+                     "--lineage", "det"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["live"]["version"] == 1 and out["live"]["kind"] == "rollback"
+    assert cli.main(["models", "status", "--registry", regdir,
+                     "--lineage", "det"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert [v["version"] for v in out["versions"]] == [1, 2]
+    assert cli.main(["models", "list", "--registry", regdir]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert "det" in out["lineages"]

@@ -22,6 +22,23 @@ def test_serve_bench_smoke_runs_and_keeps_parity(repo_root):
     assert res["stream_errors"] is None
 
 
+def test_checked_in_swap_artifact_meets_acceptance(repo_root):
+    """The swap-under-load CPU artifact of record passes every gate the
+    harness enforces live: mid-run hot-swap with zero dropped windows,
+    zero recompiles, a clean one-batch-boundary version flip, bounded p99
+    spike, and bit-parity with offline model_detect at v2 (post-swap) and
+    v1 (post-rollback)."""
+    sys.path.insert(0, str(repo_root / "benchmarks"))
+    from run_swap_bench import gates
+
+    art = json.loads((repo_root / "benchmarks" / "results" /
+                      "swap_bench_cpu.json").read_text())
+    assert gates(art) == []
+    assert art["swap"]["windows_scored_v1"] > 0
+    assert art["swap"]["windows_scored_v2"] > 0
+    assert art["shadow"]["vetoes"] >= 1  # the guardrail negative path ran
+
+
 def test_checked_in_serve_artifact_meets_acceptance(repo_root):
     """The CPU artifact of record: ≥8 concurrent streams through shared
     batches, measured occupancy ≥2 at the dominant bucket, zero recompiles
