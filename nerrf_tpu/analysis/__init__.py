@@ -1,0 +1,25 @@
+"""nerrflint — rule-based static analysis over the package's own ASTs.
+
+The invariants this repo enforces only by convention (traced functions
+stay host-pure, the serve path never recompiles after warmup, threaded
+code touches shared state under its locks, metric names follow the
+Prometheus contract) each became a bug once; every rule here is the
+generalized regression test for one of those bug classes, wired into
+tier-1 so every future PR is analyzed on every test run.
+
+Entry points: ``python scripts/nerrflint.py``, ``nerrf lint`` (CLI),
+``tests/test_analysis.py`` (the tier-1 gate).  See docs/static-analysis.md
+for the rule catalog and how to suppress or add a rule.
+
+Stdlib-only: importing this package must never initialize jax.
+"""
+
+from nerrf_tpu.analysis.engine import (  # noqa: F401
+    Baseline,
+    Finding,
+    Report,
+    Rule,
+    analyze,
+    default_rules,
+    main,
+)

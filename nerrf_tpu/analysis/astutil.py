@@ -1,0 +1,230 @@
+"""Shared AST plumbing for the nerrflint rules.
+
+Stdlib-only by design (like the registry and the tracer): the analyzer
+runs in tier-1 on every test invocation and as a queue pre-flight, so it
+must never pay a jax import.  One parse per file, one project-wide index,
+and every rule works off the same structures:
+
+  * :class:`ModuleInfo` — one parsed file: tree, source lines, the
+    import-alias table, and every function/method found (including nested
+    defs, each with a stable dotted qualname).
+  * :class:`Project` — the package-wide index plus name-based call
+    resolution (same-scope defs, then module-level defs, then imports
+    into scanned modules — deliberately NO global fallback, so a common
+    name in another file cannot create phantom call edges).
+  * :func:`dotted` — `a.b.c` for a Name/Attribute chain, else None.
+
+Resolution is a static approximation: callables passed as *parameters*
+resolve by simple name within the defining module (which is how the train
+loop's ``loss_fn`` closures link up), and anything truly dynamic —
+``model.apply``, optax transforms, dict-dispatched handlers — resolves to
+nothing and simply bounds the walk.  Rules must treat "unresolved" as
+"unknown", never as "clean by proof".
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from pathlib import Path
+from typing import Dict, List, Optional, Tuple
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """`a.b.c` for a pure Name/Attribute chain; None for anything richer
+    (calls, subscripts) — those are dynamic and out of static reach."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+@dataclasses.dataclass
+class FunctionInfo:
+    """One function/method/lambda: identity + the raw node."""
+
+    qualname: str                 # "Cls.meth", "outer.<locals>.inner", "fn"
+    module: str                   # dotted module name
+    node: ast.AST                 # FunctionDef / AsyncFunctionDef / Lambda
+    cls: Optional[str] = None     # enclosing class, when a method
+    params: Tuple[str, ...] = ()
+
+    @property
+    def line(self) -> int:
+        return getattr(self.node, "lineno", 0)
+
+
+class _StopAtNested(ast.NodeVisitor):
+    """Visitor that walks one function's body without descending into
+    nested defs/lambdas (those are their own FunctionInfo)."""
+
+    def __init__(self) -> None:
+        self.calls: List[ast.Call] = []
+
+    def visit_FunctionDef(self, node):  # noqa: N802 (ast API)
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+    visit_Lambda = visit_FunctionDef
+
+    def visit_Call(self, node):  # noqa: N802
+        self.calls.append(node)
+        self.generic_visit(node)
+
+
+def body_nodes(fn: ast.AST):
+    """Iterate a function's OWN statements/expressions, stopping at nested
+    function boundaries.  Works for lambdas (their body is an expr)."""
+    roots = fn.body if isinstance(fn.body, list) else [fn.body]
+    stack = list(roots)
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def own_calls(fn: ast.AST) -> List[ast.Call]:
+    """Call nodes lexically inside ``fn`` but not inside nested defs."""
+    return [n for n in body_nodes(fn) if isinstance(n, ast.Call)]
+
+
+def param_names(fn: ast.AST) -> Tuple[str, ...]:
+    a = fn.args
+    names = [p.arg for p in
+             (a.posonlyargs + a.args + a.kwonlyargs)]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return tuple(names)
+
+
+@dataclasses.dataclass
+class ModuleInfo:
+    path: str                     # repo-relative posix path
+    name: str                     # dotted module name
+    tree: ast.Module
+    lines: List[str]
+    imports: Dict[str, str] = dataclasses.field(default_factory=dict)
+    functions: List[FunctionInfo] = dataclasses.field(default_factory=list)
+    # simple name → defs (module-level and nested; methods excluded)
+    by_name: Dict[str, List[FunctionInfo]] = dataclasses.field(
+        default_factory=dict)
+    methods: Dict[Tuple[str, str], FunctionInfo] = dataclasses.field(
+        default_factory=dict)
+
+    def source(self, line: int) -> str:
+        return self.lines[line - 1] if 0 < line <= len(self.lines) else ""
+
+
+def _index_module(info: ModuleInfo) -> None:
+    for node in ast.walk(info.tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                info.imports[alias.asname or alias.name.split(".")[0]] = \
+                    alias.name
+        elif isinstance(node, ast.ImportFrom) and node.module \
+                and node.level == 0:
+            for alias in node.names:
+                info.imports[alias.asname or alias.name] = \
+                    f"{node.module}.{alias.name}"
+
+    def visit(node, prefix: str, cls: Optional[str]) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = f"{prefix}{child.name}"
+                fi = FunctionInfo(qual, info.name, child, cls,
+                                  param_names(child))
+                info.functions.append(fi)
+                if cls is not None and prefix == f"{cls}.":
+                    info.methods[(cls, child.name)] = fi
+                else:
+                    info.by_name.setdefault(child.name, []).append(fi)
+                visit(child, f"{qual}.<locals>.", cls)
+            elif isinstance(child, ast.ClassDef):
+                visit(child, f"{child.name}.", child.name)
+            else:
+                visit(child, prefix, cls)
+
+    visit(info.tree, "", None)
+
+
+class Project:
+    """All scanned modules plus cross-module call resolution."""
+
+    def __init__(self, root: Path, files: List[Path]) -> None:
+        self.root = Path(root)
+        self.modules: Dict[str, ModuleInfo] = {}
+        self.errors: List[str] = []
+        for path in files:
+            rel = path.relative_to(self.root).as_posix()
+            name = rel[:-3].replace("/", ".")
+            if name.endswith(".__init__"):
+                name = name[: -len(".__init__")]
+            try:
+                text = path.read_text()
+                tree = ast.parse(text, filename=rel)
+            except (OSError, SyntaxError) as e:
+                self.errors.append(f"{rel}: {type(e).__name__}: {e}")
+                continue
+            info = ModuleInfo(rel, name, tree, text.splitlines())
+            _index_module(info)
+            self.modules[name] = info
+
+    def module_of(self, fi: FunctionInfo) -> ModuleInfo:
+        return self.modules[fi.module]
+
+    def _resolve_name(self, mod: ModuleInfo, name: str
+                      ) -> List[FunctionInfo]:
+        if name in mod.by_name:
+            return mod.by_name[name]
+        full = mod.imports.get(name)
+        if full and "." in full:
+            src_mod, _, attr = full.rpartition(".")
+            target = self.modules.get(src_mod)
+            if target is not None:
+                return [f for f in target.by_name.get(attr, [])
+                        if "." not in f.qualname]  # module-level only
+        return []
+
+    def resolve_call(self, mod: ModuleInfo, caller: Optional[FunctionInfo],
+                     call: ast.Call) -> List[FunctionInfo]:
+        """Candidate definitions for a call's target (possibly empty)."""
+        d = dotted(call.func)
+        if d is None:
+            return []
+        parts = d.split(".")
+        if len(parts) == 1:
+            return self._resolve_name(mod, parts[0])
+        if parts[0] == "self" and len(parts) == 2 and caller is not None \
+                and caller.cls is not None:
+            hit = mod.methods.get((caller.cls, parts[1]))
+            return [hit] if hit else []
+        if len(parts) == 2:
+            # alias.func through an imported scanned module
+            full = mod.imports.get(parts[0])
+            target = self.modules.get(full) if full else None
+            if target is not None:
+                return [f for f in target.by_name.get(parts[1], [])
+                        if "." not in f.qualname]
+        return []
+
+
+def collect_files(root: Path, paths) -> List[Path]:
+    """Expand dirs to their .py files (sorted, skipping __pycache__)."""
+    out: List[Path] = []
+    for entry in paths:
+        p = root / entry
+        if p.is_dir():
+            out.extend(f for f in sorted(p.rglob("*.py"))
+                       if "__pycache__" not in f.parts)
+        elif p.is_file():
+            out.append(p)
+    return out

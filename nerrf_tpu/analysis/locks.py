@@ -1,0 +1,420 @@
+"""lock-discipline: shared state in the threaded planes stays under lock.
+
+Scope: the threaded serve/registry/observability code — per-class analysis
+of ``self.X`` accesses against the class's own ``threading.Lock`` /
+``RLock`` / ``Condition`` attributes (constructor-assigned or dataclass
+``field(default_factory=threading.Lock)``).
+
+The discipline inferred, per class:
+
+  * an attribute is **guarded** when it is written or mutated in place at
+    least once while one of the class's locks is held — that lock set is
+    its guard;
+  * a **mutation or rebind** of a guarded attribute anywhere outside
+    ``__init__`` without a guard lock held is a finding;
+  * a **read** of a guarded attribute is a finding only when the attribute
+    is a *container* mutated in place somewhere (``d[k]=``, ``.append``,
+    ``.pop`` …): reading a container mid-mutation observes torn state.
+    Attributes that are only ever *rebound* (pointer swaps — the live
+    params pointer, the shadow tuple) read atomically under the GIL, so
+    bare reads of those stay legal by design;
+  * held-lock state propagates into private methods (``_name``) whose
+    intra-class call sites all hold the lock (fixpoint) — how
+    ``_poll_locked``-style bodies are understood to run under ``poll()``'s
+    lock.  Public methods are always assumed callable bare.
+
+Plus the **lock-acquisition-order graph**: an edge L→M whenever M is
+acquired (lexically, or through a call to a uniquely-named method of a
+scanned class that acquires M) while L is held.  A cycle means two
+threads can deadlock batcher↔manager↔registry; any cycle is a finding.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
+
+from nerrf_tpu.analysis.astutil import ModuleInfo, dotted
+from nerrf_tpu.analysis.engine import Finding, Rule
+
+DEFAULT_SCOPE = ("nerrf_tpu/serve/", "nerrf_tpu/registry/",
+                 "nerrf_tpu/observability.py")
+
+_LOCK_TYPES = frozenset({"Lock", "RLock", "Condition"})
+_MUTATORS = frozenset({
+    "append", "appendleft", "extend", "insert", "add", "remove", "discard",
+    "pop", "popleft", "popitem", "clear", "update", "setdefault",
+})
+
+
+@dataclasses.dataclass
+class _Access:
+    attr: str
+    kind: str          # "read" | "mutate" | "rebind"
+    line: int
+    method: str
+    held: FrozenSet[str]
+
+
+@dataclasses.dataclass
+class _ClassInfo:
+    name: str
+    mod: ModuleInfo
+    locks: Set[str] = dataclasses.field(default_factory=set)
+    methods: Dict[str, ast.AST] = dataclasses.field(default_factory=dict)
+    accesses: List[_Access] = dataclasses.field(default_factory=list)
+    # method → [(callee-or-None for foreign, name, held-at-site)]
+    calls: List[Tuple[str, str, FrozenSet[str]]] = \
+        dataclasses.field(default_factory=list)
+    # acquisitions observed: (method, acquired-name, held-at-site, line)
+    acquisitions: List[Tuple[str, str, FrozenSet[str], int]] = \
+        dataclasses.field(default_factory=list)
+    entry: Dict[str, FrozenSet[str]] = dataclasses.field(default_factory=dict)
+
+
+def _is_lock_ctor(value: ast.AST) -> bool:
+    if isinstance(value, ast.Call):
+        d = dotted(value.func)
+        if d is not None and d.split(".")[-1] in _LOCK_TYPES:
+            return True
+        # dataclasses.field(default_factory=threading.Lock)
+        if d is not None and d.split(".")[-1] == "field":
+            for kw in value.keywords:
+                if kw.arg == "default_factory":
+                    fd = dotted(kw.value)
+                    if fd is not None and fd.split(".")[-1] in _LOCK_TYPES:
+                        return True
+    return False
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _collect_classes(mod: ModuleInfo) -> List[_ClassInfo]:
+    out = []
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        ci = _ClassInfo(node.name, mod)
+        for stmt in node.body:
+            if isinstance(stmt, ast.AnnAssign) and \
+                    isinstance(stmt.target, ast.Name) and \
+                    stmt.value is not None and _is_lock_ctor(stmt.value):
+                ci.locks.add(stmt.target.id)
+            elif isinstance(stmt, ast.Assign) and _is_lock_ctor(stmt.value):
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name):
+                        ci.locks.add(t.id)
+            elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                ci.methods[stmt.name] = stmt
+                for sub in ast.walk(stmt):
+                    if isinstance(sub, ast.Assign) and \
+                            _is_lock_ctor(sub.value):
+                        for t in sub.targets:
+                            attr = _self_attr(t)
+                            if attr:
+                                ci.locks.add(attr)
+        out.append(ci)
+    return out
+
+
+def _walk_method(ci: _ClassInfo, name: str, node: ast.AST,
+                 lock_attr_names: Set[str]) -> None:
+    """Record accesses, intra/foreign calls and acquisitions with the
+    lexically-held lock set."""
+
+    def rec_target(t: ast.AST, held, kind: str) -> None:
+        attr = _self_attr(t)
+        if attr and attr not in ci.locks:
+            ci.accesses.append(_Access(attr, kind, t.lineno, name, held))
+        elif isinstance(t, ast.Subscript):
+            attr = _self_attr(t.value)
+            if attr and attr not in ci.locks:
+                ci.accesses.append(
+                    _Access(attr, "mutate", t.lineno, name, held))
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for el in t.elts:
+                rec_target(el, held, kind)
+
+    def walk(n: ast.AST, held: FrozenSet[str]) -> None:
+        if isinstance(n, ast.With):
+            inner = set(held)
+            for item in n.items:
+                attr = _self_attr(item.context_expr)
+                if attr and attr in ci.locks:
+                    inner.add(attr)
+                    ci.acquisitions.append(
+                        (name, attr, held, item.context_expr.lineno))
+                elif isinstance(item.context_expr, ast.Attribute) and \
+                        item.context_expr.attr in lock_attr_names:
+                    # with <obj>.<lockattr>: — a foreign acquisition,
+                    # tracked for the order graph only
+                    ci.acquisitions.append(
+                        (name, item.context_expr.attr, held,
+                         item.context_expr.lineno))
+                    inner.add(f"~{item.context_expr.attr}")
+                if item.optional_vars is not None:
+                    walk(item.optional_vars, frozenset(inner))
+                walk(item.context_expr, held)
+            for stmt in n.body:
+                walk(stmt, frozenset(inner))
+            return
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda)):
+            return  # nested defs escape the held set (run later)
+        if isinstance(n, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            targets = n.targets if isinstance(n, ast.Assign) else [n.target]
+            kind = "mutate" if isinstance(n, ast.AugAssign) else "rebind"
+            for t in targets:
+                rec_target(t, held, kind)
+            if n.value is not None:
+                walk(n.value, held)
+            return
+        if isinstance(n, ast.Delete):
+            for t in n.targets:
+                rec_target(t, held, "mutate")
+            return
+        if isinstance(n, ast.Call):
+            d = dotted(n.func)
+            if d is not None:
+                parts = d.split(".")
+                if parts[0] == "self" and len(parts) == 2:
+                    ci.calls.append((name, parts[1], held))
+                elif len(parts) >= 2:
+                    ci.calls.append((name, f"*.{parts[-1]}", held))
+                if len(parts) >= 2 and parts[-1] in _MUTATORS:
+                    attr = _self_attr(n.func.value)
+                    if attr and attr not in ci.locks:
+                        ci.accesses.append(_Access(
+                            attr, "mutate", n.lineno, name, held))
+        if isinstance(n, ast.Attribute) and isinstance(n.ctx, ast.Load):
+            attr = _self_attr(n)
+            if attr and attr not in ci.locks:
+                ci.accesses.append(_Access(attr, "read", n.lineno,
+                                           name, held))
+        for child in ast.iter_child_nodes(n):
+            walk(child, held)
+
+    for stmt in node.body:
+        walk(stmt, frozenset())
+
+
+class LockDiscipline(Rule):
+    id = "lock-discipline"
+    description = ("lock-guarded attribute access outside `with self.lock` "
+                   "+ lock-acquisition-order cycles (serve/registry/"
+                   "observability)")
+
+    def __init__(self, scope: Optional[Tuple[str, ...]] = DEFAULT_SCOPE
+                 ) -> None:
+        self.scope = scope
+
+    def _in_scope(self, mod: ModuleInfo) -> bool:
+        if self.scope is None:
+            return True
+        return any(mod.path.startswith(s) or mod.path == s.rstrip("/")
+                   for s in self.scope)
+
+    def inventory(self, project) -> Dict[str, List[str]]:
+        """Class → lock attrs, for docs/tests ('the module-level lock
+        inventory')."""
+        out: Dict[str, List[str]] = {}
+        for mod in project.modules.values():
+            if not self._in_scope(mod):
+                continue
+            for ci in _collect_classes(mod):
+                if ci.locks:
+                    out[f"{mod.path}:{ci.name}"] = sorted(ci.locks)
+        return out
+
+    def run(self, project) -> List[Finding]:
+        classes: List[_ClassInfo] = []
+        for mod in project.modules.values():
+            if self._in_scope(mod):
+                classes.extend(_collect_classes(mod))
+        lock_attr_names = {lk for ci in classes for lk in ci.locks}
+        for ci in classes:
+            for mname, mnode in ci.methods.items():
+                _walk_method(ci, mname, mnode, lock_attr_names)
+        findings = []
+        for ci in classes:
+            if ci.locks:
+                self._propagate_entry(ci)
+                findings.extend(self._discipline(ci))
+        findings.extend(self._order_cycles(classes))
+        return findings
+
+    # -- entry-held propagation ----------------------------------------------
+
+    def _propagate_entry(self, ci: _ClassInfo) -> None:
+        ci.entry = {m: frozenset() for m in ci.methods}
+        sites: Dict[str, List[Tuple[str, FrozenSet[str]]]] = {}
+        for caller, callee, held in ci.calls:
+            if callee in ci.methods:
+                sites.setdefault(callee, []).append((caller, held))
+        for _ in range(4):  # fixpoint over short call chains
+            changed = False
+            for m in ci.methods:
+                if not m.startswith("_") or m.startswith("__") \
+                        or m not in sites:
+                    continue  # public or uncalled: assume callable bare
+                new = None
+                for caller, held in sites[m]:
+                    eff = held | ci.entry.get(caller, frozenset())
+                    new = eff if new is None else (new & eff)
+                new = new or frozenset()
+                if new != ci.entry[m]:
+                    ci.entry[m] = new
+                    changed = True
+            if not changed:
+                break
+
+    # -- per-class discipline -------------------------------------------------
+
+    def _discipline(self, ci: _ClassInfo) -> List[Finding]:
+        guards: Dict[str, Set[str]] = {}
+        containers: Set[str] = set()
+        for a in ci.accesses:
+            held = a.held | ci.entry.get(a.method, frozenset())
+            if a.kind in ("mutate", "rebind"):
+                if a.kind == "mutate":
+                    containers.add(a.attr)
+                if a.method != "__init__" and held:
+                    guards.setdefault(a.attr, set()).update(
+                        h for h in held if not h.startswith("~"))
+        out: List[Finding] = []
+        seen = set()
+        for a in ci.accesses:
+            if a.method == "__init__" or a.attr not in guards:
+                continue
+            held = a.held | ci.entry.get(a.method, frozenset())
+            if held & guards[a.attr]:
+                continue
+            if a.kind == "read" and a.attr not in containers:
+                continue  # rebound-only pointer: GIL-atomic snapshot read
+            key = (ci.name, a.method, a.attr, a.kind)
+            if key in seen:
+                continue
+            seen.add(key)
+            lock = "/".join(sorted(guards[a.attr]))
+            verb = {"read": "read", "mutate": "in-place mutation",
+                    "rebind": "write"}[a.kind]
+            out.append(Finding(
+                rule=self.id, path=ci.mod.path, line=a.line,
+                message=f"{verb} of {ci.name}.{a.attr} in "
+                        f"{ci.name}.{a.method} without holding "
+                        f"self.{lock} (guarded elsewhere)",
+                hint=f"take `with self.{lock}:` around the access, or "
+                     f"justify why this thread owns the value here",
+                anchor=f"{ci.name}.{a.method}:{a.attr}:{a.kind}"))
+        return out
+
+    # -- acquisition-order graph ----------------------------------------------
+
+    def _order_cycles(self, classes: List[_ClassInfo]) -> List[Finding]:
+        # unique method name → acquisition set (transitive within class)
+        method_owner: Dict[str, List[Tuple[_ClassInfo, str]]] = {}
+        for ci in classes:
+            for m in ci.methods:
+                method_owner.setdefault(m, []).append((ci, m))
+        acquires: Dict[Tuple[str, str], Set[str]] = {}
+        for ci in classes:
+            for m in ci.methods:
+                acquires[(ci.name, m)] = {
+                    f"{ci.name}.{a}" for mm, a, _h, _l in ci.acquisitions
+                    if mm == m and a in ci.locks}
+        for _ in range(4):  # transitive closure over intra-class calls
+            for ci in classes:
+                for caller, callee, _held in ci.calls:
+                    if callee in ci.methods:
+                        acquires[(ci.name, caller)] |= \
+                            acquires[(ci.name, callee)]
+
+        def qual(ci: _ClassInfo, held_name: str) -> Optional[str]:
+            if held_name.startswith("~"):
+                bare = held_name[1:]
+                owners = [c.name for c in classes if bare in c.locks]
+                return f"{owners[0]}.{bare}" if len(owners) == 1 else None
+            return f"{ci.name}.{held_name}"
+
+        edges: Dict[str, Set[str]] = {}
+        edge_site: Dict[Tuple[str, str], str] = {}
+
+        def add_edge(a: str, b: str, site: str) -> None:
+            if a != b:
+                edges.setdefault(a, set()).add(b)
+                edge_site.setdefault((a, b), site)
+
+        for ci in classes:
+            for m, acq, held, line in ci.acquisitions:
+                tgt = qual(ci, f"~{acq}" if acq not in ci.locks else acq)
+                if tgt is None:
+                    continue
+                for h in held | ci.entry.get(m, frozenset()):
+                    src = qual(ci, h)
+                    if src:
+                        add_edge(src, tgt, f"{ci.mod.path}:{line}")
+            for m, callee, held in ci.calls:
+                eff = held | ci.entry.get(m, frozenset())
+                if not eff:
+                    continue
+                key = callee[2:] if callee.startswith("*.") else callee
+                owners = method_owner.get(key, [])
+                if callee.startswith("*.") and len(owners) != 1:
+                    continue  # ambiguous foreign method: no edge
+                for oci, om in (owners if callee.startswith("*.")
+                                else [(ci, key)] if key in ci.methods
+                                else []):
+                    for tgt in acquires.get((oci.name, om), ()):  # noqa: B007
+                        for h in eff:
+                            src = qual(ci, h)
+                            if src:
+                                add_edge(src, tgt,
+                                         f"{ci.mod.path}:{ci.name}.{m}")
+
+        return self._find_cycles(edges, edge_site)
+
+    def _find_cycles(self, edges, edge_site) -> List[Finding]:
+        out: List[Finding] = []
+        seen_cycles = set()
+        state: Dict[str, int] = {}
+        stack: List[str] = []
+
+        def dfs(n: str) -> None:
+            state[n] = 1
+            stack.append(n)
+            for m in sorted(edges.get(n, ())):
+                if state.get(m, 0) == 0:
+                    dfs(m)
+                elif state.get(m) == 1:
+                    cyc = stack[stack.index(m):] + [m]
+                    lo = min(range(len(cyc) - 1), key=lambda i: cyc[i])
+                    norm = tuple(cyc[lo:-1] + cyc[:lo])
+                    if norm in seen_cycles:
+                        continue
+                    seen_cycles.add(norm)
+                    site = edge_site.get((cyc[0], cyc[1]), "?")
+                    out.append(Finding(
+                        rule=self.id, path=site.split(":")[0],
+                        line=int(site.split(":")[1])
+                        if site.split(":")[1].isdigit() else 1,
+                        message="lock-acquisition-order cycle: "
+                                + " -> ".join(cyc)
+                                + " — two threads taking these in opposite "
+                                  "order deadlock",
+                        hint="impose one global order (document it in "
+                             "docs/static-analysis.md) or release before "
+                             "calling across subsystems",
+                        anchor="cycle:" + ">".join(norm)))
+            stack.pop()
+            state[n] = 2
+
+        for n in sorted(edges):
+            if state.get(n, 0) == 0:
+                dfs(n)
+        return out

@@ -1,0 +1,218 @@
+"""metrics-contract: naming/typing/help rules for every registry call.
+
+The former ``scripts/check_metrics.py`` (that script is now a thin shim
+over this module), generalized into the nerrflint engine as a Rule.  Scans
+``nerrf_tpu/``, ``bench.py`` and ``benchmarks/`` for every metric name
+passed to a ``MetricsRegistry`` method and fails on:
+
+  * counters whose name does not end in ``_total`` (Prometheus convention
+    — a counter without it reads as a gauge on every dashboard);
+  * one name registered under conflicting types (the registry renders one
+    ``# TYPE`` block per name; a clash silently splits or corrupts series);
+  * metric names never registered with ``help=`` text at any call site;
+  * contract names (REQUIRED) that dashboards/runbooks key off no longer
+    being registered anywhere.
+
+Names passed as UPPER_CASE module constants are resolved from the same
+file's literal assignment (the tracing spine registers its histogram this
+way).  Text-scan rather than AST on purpose: the call sites include
+benchmarks outside the AST scan set, and the regex has to see exactly what
+a grep-armed operator would see.
+"""
+
+from __future__ import annotations
+
+import argparse
+import re
+import sys
+from pathlib import Path
+from typing import List
+
+from nerrf_tpu.analysis.engine import Finding, Rule
+
+REPO = Path(__file__).resolve().parents[2]
+SCAN = ("nerrf_tpu", "bench.py", "benchmarks")
+
+# Contract metrics: names dashboards/alerts/docs depend on, which must
+# keep being registered SOMEWHERE in the codebase — deleting the last call
+# site would silently blank a dashboard panel.  (The model-lifecycle set
+# rides the registry subsystem: docs/model-lifecycle.md's runbook keys off
+# these exact names.)
+REQUIRED = (
+    "model_info",
+    "registry_swaps_total",
+    "registry_shadow_windows_total",
+    "registry_shadow_disagreement_rate",
+    "registry_shadow_score_drift",
+    "registry_shadow_vetoes_total",
+    "registry_promotions_total",
+    "serve_windows_scored_total",
+    "serve_recompiles_total",
+)
+
+_CALL = re.compile(
+    r"\.(counter_inc|gauge_set|histogram_observe)\(\s*"
+    r"(?:['\"](?P<lit>[A-Za-z0-9_:]+)['\"]|(?P<const>[A-Z][A-Z0-9_]*))")
+_TYPE_OF = {"counter_inc": "counter", "gauge_set": "gauge",
+            "histogram_observe": "histogram"}
+
+
+def _call_chunk(text: str, start: int) -> str:
+    """The call's argument text, from its opening paren to the balanced
+    close (string-literal parens would only over-extend the chunk, which
+    is harmless for the ``help=`` presence check)."""
+    i = text.index("(", start)
+    depth = 0
+    for j in range(i, min(len(text), i + 4000)):
+        if text[j] == "(":
+            depth += 1
+        elif text[j] == ")":
+            depth -= 1
+            if depth == 0:
+                return text[i:j + 1]
+    return text[i:i + 4000]
+
+
+def _resolve_const(text: str, name: str) -> str | None:
+    m = re.search(rf"^{name}\s*=\s*['\"]([A-Za-z0-9_:]+)['\"]",
+                  text, re.MULTILINE)
+    return m.group(1) if m else None
+
+
+def scan(repo: Path = REPO) -> dict[str, dict]:
+    """name → {"types": {type: [sites]}, "has_help": bool, "sites": [...]}"""
+    metrics: dict[str, dict] = {}
+    files: list[Path] = []
+    for entry in SCAN:
+        p = repo / entry
+        files.extend(sorted(p.rglob("*.py")) if p.is_dir() else [p])
+    for path in files:
+        if not path.exists():
+            continue
+        text = path.read_text()
+        rel = path.relative_to(repo)
+        for m in _CALL.finditer(text):
+            name = m.group("lit")
+            if name is None:
+                name = _resolve_const(text, m.group("const"))
+                if name is None:
+                    continue  # not a literal-backed constant: out of scope
+            line = text.count("\n", 0, m.start()) + 1
+            site = f"{rel}:{line}"
+            mtype = _TYPE_OF[m.group(1)]
+            rec = metrics.setdefault(
+                name, {"types": {}, "has_help": False, "sites": []})
+            rec["types"].setdefault(mtype, []).append(site)
+            rec["sites"].append(site)
+            if re.search(r"\bhelp\s*=", _call_chunk(text, m.start())):
+                rec["has_help"] = True
+    return metrics
+
+
+def _site_loc(site: str) -> tuple[str, int]:
+    path, _, line = site.rpartition(":")
+    return path, int(line) if line.isdigit() else 1
+
+
+def findings(metrics: dict[str, dict],
+             required=REQUIRED) -> List[Finding]:
+    """Structured findings over a scan — the engine-facing face of
+    ``lint`` + ``check_required``."""
+    out: List[Finding] = []
+    for name, rec in sorted(metrics.items()):
+        path, line = _site_loc(rec["sites"][0])
+        if "counter" in rec["types"] and not name.endswith("_total"):
+            out.append(Finding(
+                rule="metrics-contract", path=path, line=line,
+                message=f"counter {name!r} missing the _total suffix",
+                hint="Prometheus convention: a counter without _total "
+                     "reads as a gauge on every dashboard",
+                anchor=f"{name}:suffix"))
+        if len(rec["types"]) > 1:
+            detail = "; ".join(
+                f"{t} at {', '.join(s[:2])}"
+                for t, s in sorted(rec["types"].items()))
+            out.append(Finding(
+                rule="metrics-contract", path=path, line=line,
+                message=f"metric {name!r} registered under conflicting "
+                        f"types: {detail}",
+                hint="one name renders one # TYPE block; pick one type",
+                anchor=f"{name}:type-clash"))
+        if not rec["has_help"]:
+            out.append(Finding(
+                rule="metrics-contract", path=path, line=line,
+                message=f"metric {name!r} never registered with help text",
+                hint="pass help= at one call site; an unexplained series "
+                     "is a dashboard mystery",
+                anchor=f"{name}:no-help"))
+    for name in required:
+        if name not in metrics:
+            out.append(Finding(
+                rule="metrics-contract", path=SCAN[0], line=1,
+                message=f"contract metric {name!r} is no longer registered "
+                        f"anywhere (a dashboard/runbook depends on it)",
+                hint="re-register it, or retire it from REQUIRED together "
+                     "with the dashboards that chart it",
+                anchor=f"{name}:required"))
+    return out
+
+
+def lint(metrics: dict[str, dict]) -> list[str]:
+    """Back-compat string form (the shim's historical API): naming/typing/
+    help errors, one line each, sites appended."""
+    errors = []
+    for name, rec in sorted(metrics.items()):
+        sites = ", ".join(rec["sites"][:3])
+        if "counter" in rec["types"] and not name.endswith("_total"):
+            errors.append(
+                f"counter {name!r} missing the _total suffix ({sites})")
+        if len(rec["types"]) > 1:
+            detail = "; ".join(
+                f"{t} at {', '.join(s[:2])}"
+                for t, s in sorted(rec["types"].items()))
+            errors.append(
+                f"metric {name!r} registered under conflicting types: "
+                f"{detail}")
+        if not rec["has_help"]:
+            errors.append(
+                f"metric {name!r} never registered with help text ({sites})")
+    return errors
+
+
+def check_required(metrics: dict[str, dict],
+                   required=REQUIRED) -> list[str]:
+    return [f"contract metric {name!r} is no longer registered anywhere "
+            f"(a dashboard/runbook depends on it)"
+            for name in required if name not in metrics]
+
+
+class MetricsContract(Rule):
+    id = "metrics-contract"
+    description = ("metric naming/typing/help contract + required contract "
+                   "names (nerrf_tpu, bench.py, benchmarks)")
+
+    def __init__(self, required=REQUIRED) -> None:
+        self.required = required
+
+    def run(self, project) -> List[Finding]:
+        return findings(scan(project.root), required=self.required)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--list", action="store_true",
+                    help="print the metric inventory and exit")
+    args = ap.parse_args(argv)
+    metrics = scan()
+    if args.list:
+        for name, rec in sorted(metrics.items()):
+            types = "/".join(sorted(rec["types"]))
+            print(f"{name:<36} {types:<10} "
+                  f"{'help' if rec['has_help'] else 'NO HELP':<8} "
+                  f"{len(rec['sites'])} site(s)")
+    errors = lint(metrics) + check_required(metrics)
+    for e in errors:
+        print(f"check_metrics: {e}", file=sys.stderr)
+    if not errors:
+        print(f"check_metrics: {len(metrics)} metric names clean")
+    return 1 if errors else 0

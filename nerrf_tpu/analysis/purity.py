@@ -1,0 +1,236 @@
+"""jax-purity: host effects must not be reachable inside traced scope.
+
+A function that runs under `jit`/`pmap`/`vmap`/`scan`/`shard_map` executes
+at *trace time*: a `time.perf_counter()` there measures tracing once and
+then becomes a baked-in constant; a `span()` or MetricsRegistry call
+records one event per compile instead of per step; `print` fires at trace
+time only (and silently stops firing on the cached program).  Every one of
+these is a bug that type-checks, runs, and quietly lies.
+
+Traced scope is found statically:
+
+  * functions decorated with a tracing wrapper (`@jax.jit`,
+    `@partial(jax.jit, ...)`, bare `@jit`), and
+  * named functions passed INTO a wrapper call (`jax.jit(f)`,
+    `jax.lax.scan(body, ...)`, `shard_map(fn, ...)`, `grad(loss)`), and
+  * lambdas passed into a wrapper call (checked inline),
+
+then closed over the static call graph (astutil.Project resolution: local
+defs, module defs, imports into scanned modules).  The walk is an
+under-approximation — `model.apply` and other dynamic dispatch end it —
+so a clean run means "no violation is statically visible", not a proof.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from nerrf_tpu.analysis.astutil import (
+    FunctionInfo,
+    Project,
+    dotted,
+    own_calls,
+)
+from nerrf_tpu.analysis.engine import Finding, Rule
+
+# call names (last dotted segment) that put their function argument(s)
+# under a jax trace
+TRACING_WRAPPERS = frozenset({
+    "jit", "pmap", "vmap", "shard_map", "scan", "fori_loop", "while_loop",
+    "cond", "switch", "grad", "value_and_grad", "remat", "defvjp",
+})
+
+# effect → (label, hint) keyed by the classifier below
+_HINTS = {
+    "host-clock": "hoist the timing to the caller (host side) or use a "
+                  "traced counter carried through the state",
+    "host-rng": "thread a jax.random key instead of host randomness",
+    "print": "tracing runs once: use jax.debug.print for per-step output "
+             "or log from the host loop",
+    "span": "spans measure tracing, not execution — wrap the CALL SITE, "
+            "or use jax.named_scope for device-side attribution",
+    "metrics": "registry writes fire once per compile inside a trace; "
+               "record from the host loop after fetching results",
+    "io": "file/socket I/O cannot run per-step inside a compiled program; "
+          "move it to the host loop",
+    "logging": "host logging inside a trace fires at compile time only",
+}
+
+
+def classify_effect(call: ast.Call, mod=None) -> Optional[Tuple[str, str]]:
+    """→ (effect-kind, display-name) when this call is a host effect.
+
+    The dotted name is canonicalized through the module's import-alias
+    table first, so ``import time as _time`` / ``from time import
+    perf_counter`` cannot smuggle a host clock past the prefix checks."""
+    d = dotted(call.func)
+    if d is None:
+        return None
+    parts = d.split(".")
+    if mod is not None:
+        full = mod.imports.get(parts[0])
+        if full:
+            parts = full.split(".") + parts[1:]
+            d = ".".join(parts)
+    last = parts[-1]
+    if d in ("print", "input", "breakpoint"):
+        return "print", d
+    if d == "open":
+        return "io", "open"
+    if parts[0] == "time":
+        return "host-clock", d
+    if parts[0] == "random" or d.startswith(("np.random.", "numpy.random.")):
+        return "host-rng", d
+    if last in ("counter_inc", "gauge_set", "histogram_observe"):
+        return "metrics", d
+    if last in ("span", "trace_span") and "re." not in d:
+        return "span", d
+    if parts[0] in ("socket", "subprocess", "shutil"):
+        return "io", d
+    if parts[0] == "os" and len(parts) > 1 and parts[1] != "path":
+        return "io", d
+    if last in ("write_text", "read_text", "write_bytes", "read_bytes",
+                "unlink", "rename", "mkdir"):
+        return "io", d
+    if d == "log" or last == "_log" or parts[0] in ("logging", "logger"):
+        return "logging", d
+    return None
+
+
+def _decorator_traces(dec: ast.AST) -> bool:
+    d = dotted(dec)
+    if d is not None:
+        return d.split(".")[-1] in TRACING_WRAPPERS
+    if isinstance(dec, ast.Call):
+        fd = dotted(dec.func)
+        if fd is not None and fd.split(".")[-1] in TRACING_WRAPPERS:
+            return True  # @jax.jit(...) / @jit(static_argnames=...)
+        if fd is not None and fd.split(".")[-1] == "partial":
+            return any(_decorator_traces(a) for a in dec.args)
+    return False
+
+
+def traced_entry_points(project: Project
+                        ) -> Tuple[List[FunctionInfo], List[Tuple]]:
+    """→ (traced named functions, traced lambdas as (module, node)).
+    Cached on the project — every rule that cares about traced scope
+    shares one module sweep."""
+    cached = getattr(project, "_traced_entry", None)
+    if cached is not None:
+        return cached
+    roots: List[FunctionInfo] = []
+    seen = set()
+    lambdas: List[Tuple] = []
+    for mod in project.modules.values():
+        for fi in mod.functions:
+            node = fi.node
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and any(_decorator_traces(d) for d in
+                            node.decorator_list):
+                if id(node) not in seen:
+                    seen.add(id(node))
+                    roots.append(fi)
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            d = dotted(node.func)
+            if d is None or d.split(".")[-1] not in TRACING_WRAPPERS:
+                continue
+            # every positional arg: jit(f), scan(body, init),
+            # fori_loop(lo, hi, body, init), cond(p, tf, ff) — a Name that
+            # happens not to be a function simply resolves to nothing
+            for arg in node.args:
+                if isinstance(arg, ast.Lambda):
+                    lambdas.append((mod, arg))
+                elif isinstance(arg, ast.Name):
+                    for fi in mod.by_name.get(arg.id, []):
+                        if id(fi.node) not in seen:
+                            seen.add(id(fi.node))
+                            roots.append(fi)
+    project._traced_entry = (roots, lambdas)
+    return roots, lambdas
+
+
+def reachable_traced(project: Project
+                     ) -> Dict[int, Tuple[FunctionInfo, str]]:
+    """id(node) → (FunctionInfo, root-qualname) for every function
+    statically reachable from a traced entry point.  Cached on the
+    project: jax-purity and recompile-hazard share one traversal."""
+    cached = getattr(project, "_traced_reachable", None)
+    if cached is not None:
+        return cached
+    roots, _ = traced_entry_points(project)
+    out: Dict[int, Tuple[FunctionInfo, str]] = {}
+    work = [(fi, fi.qualname) for fi in roots]
+    while work:
+        fi, root = work.pop()
+        if id(fi.node) in out:
+            continue
+        out[id(fi.node)] = (fi, root)
+        mod = project.module_of(fi)
+        for call in own_calls(fi.node):
+            for callee in project.resolve_call(mod, fi, call):
+                if id(callee.node) not in out:
+                    work.append((callee, root))
+    project._traced_reachable = out
+    return out
+
+
+def traced_lambdas(project: Project) -> List[Tuple]:
+    """(module, lambda-node, stable-name) per traced lambda; the name is
+    the per-module ordinal (`<lambda#2>`), never a line number, so
+    baseline anchors survive unrelated edits."""
+    cached = getattr(project, "_traced_lambdas", None)
+    if cached is not None:
+        return cached
+    out: List[Tuple] = []
+    counts: Dict[str, int] = {}
+    for mod, lam in traced_entry_points(project)[1]:
+        counts[mod.name] = counts.get(mod.name, 0) + 1
+        out.append((mod, lam, f"<lambda#{counts[mod.name]}>"))
+    project._traced_lambdas = out
+    return out
+
+
+class JaxPurity(Rule):
+    id = "jax-purity"
+    description = ("host effects (time/random/print/span/metrics/IO) "
+                   "reachable inside jit/pmap/vmap/scan/shard_map scope")
+
+    def run(self, project: Project) -> List[Finding]:
+        findings: List[Finding] = []
+        reported: Set[Tuple[int, int]] = set()  # (fn-node, call-line)
+
+        def check(fn_node, mod, qual: str, root: str) -> None:
+            # per-(scope, effect) ordinal so a SECOND identical effect in
+            # one function gets its own anchor: a suppression of the first
+            # must never hide a newly added duplicate, and anchors stay
+            # line-number-free (baseline stability)
+            ordinals: Dict[str, int] = {}
+            for call in own_calls(fn_node):
+                eff = classify_effect(call, mod)
+                if eff is None:
+                    continue
+                if (id(fn_node), call.lineno) in reported:
+                    continue
+                reported.add((id(fn_node), call.lineno))
+                kind, name = eff
+                via = "" if qual == root else f" (reached from {root})"
+                ordinals[name] = ordinals.get(name, 0) + 1
+                anchor = f"{qual}:{name}" if ordinals[name] == 1 \
+                    else f"{qual}:{name}@{ordinals[name]}"
+                findings.append(Finding(
+                    rule=self.id, path=mod.path, line=call.lineno,
+                    message=f"{name}() inside traced scope of "
+                            f"{qual}{via}: {kind} effects run at trace "
+                            f"time, not per step",
+                    hint=_HINTS[kind],
+                    anchor=anchor,
+                ))
+
+        for fi, root in reachable_traced(project).values():
+            check(fi.node, project.module_of(fi), fi.qualname, root)
+        for mod, lam, name in traced_lambdas(project):
+            check(lam, mod, name, name)
+        return findings

@@ -1,0 +1,180 @@
+"""recompile-hazard: patterns that break the zero-recompile contract.
+
+The serve plane compiles one program per capacity bucket at start() and
+must never compile again (PR 3's contract, in the compile-cost spirit of
+TpuGraphs/PyGraph); training compiles one step program.  The patterns this
+rule flags all defeat that by feeding Python-level values that vary call
+to call into traced scope:
+
+  * **data-dependent branch** — `if`/`while` on a traced function's array
+    argument concretizes the tracer (ConcretizationTypeError at best; a
+    silently static branch at worst).  Branch on `jnp.where`/`lax.cond`
+    instead.  Shape-tuple branches recompile per shape — the exact bucket
+    explosion the serve ladder exists to prevent.
+  * **scalar concretization** — `int()`/`float()`/`bool()` on a traced
+    value forces a host sync or a trace error; as a jit argument it
+    becomes a fresh static value (and a fresh program) per distinct input.
+  * **dict-iteration pytree build** — a statement-level `for` over
+    `.items()/.keys()/.values()` inside traced scope unrolls per key; a
+    key set that varies across calls is a new program each time.
+    (Comprehensions over fixed-schema batch dicts are the JAX idiom and
+    stay allowed.)
+  * **f-string in traced scope** — a string built from runtime values
+    (bucket keys, label values) at trace time either concretizes or bakes
+    one program per distinct string.  Allowed inside `raise`/`assert`,
+    where it only runs on the error path.
+
+Scope: the same statically-reachable traced set as jax-purity.  Arguments
+declared static (`static_argnames`/`static_argnums`) are exempt from the
+branch check — branching on a static is *the* supported way to specialize.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from nerrf_tpu.analysis.astutil import dotted
+from nerrf_tpu.analysis.engine import Finding, Rule
+from nerrf_tpu.analysis.purity import reachable_traced
+
+
+def _static_params(fn_node) -> Set[str]:
+    """Parameter names declared static on the function's own jit
+    decorator (`static_argnames=(...)` / `static_argnums=(...)`)."""
+    out: Set[str] = set()
+    if not isinstance(fn_node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return out
+    params = [a.arg for a in fn_node.args.posonlyargs + fn_node.args.args]
+    for dec in fn_node.decorator_list:
+        for call in ast.walk(dec):
+            if not isinstance(call, ast.Call):
+                continue
+            for kw in call.keywords:
+                if kw.arg == "static_argnames":
+                    for node in ast.walk(kw.value):
+                        if isinstance(node, ast.Constant) \
+                                and isinstance(node.value, str):
+                            out.add(node.value)
+                elif kw.arg == "static_argnums":
+                    for node in ast.walk(kw.value):
+                        if isinstance(node, ast.Constant) \
+                                and isinstance(node.value, int) \
+                                and 0 <= node.value < len(params):
+                            out.add(params[node.value])
+    return out
+
+
+def _names_in(node) -> Set[str]:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def _in_raise_or_assert(node, parents) -> bool:
+    p = parents.get(id(node))
+    while p is not None:
+        if isinstance(p, (ast.Raise, ast.Assert)):
+            return True
+        p = parents.get(id(p))
+    return False
+
+
+class RecompileHazard(Rule):
+    id = "recompile-hazard"
+    description = ("data-dependent branches, scalar concretization, dict "
+                   "unrolling and f-string keys inside traced scope")
+
+    def run(self, project: "Project") -> List[Finding]:  # noqa: F821
+        findings: List[Finding] = []
+        for fi, root in reachable_traced(project).values():
+            findings.extend(self._check(project, fi, root))
+        return findings
+
+    def _check(self, project, fi, root: str) -> List[Finding]:
+        mod = project.module_of(fi)
+        node = fi.node
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return []
+        traced_params = (set(fi.params) - _static_params(node)) - {"self"}
+        via = "" if fi.qualname == root else f" (reached from {root})"
+        out: List[Finding] = []
+        ordinals: dict = {}
+
+        def anchor(stem: str) -> str:
+            # ordinal-suffixed when a stem repeats in one function —
+            # anchors must stay line-number-free (baseline stability) yet
+            # unique per site so one suppression never hides a new twin
+            ordinals[stem] = ordinals.get(stem, 0) + 1
+            return stem if ordinals[stem] == 1 \
+                else f"{stem}@{ordinals[stem]}"
+
+        # parent map for the raise/assert exemption, bounded to this fn
+        parents = {}
+        stack = [node]
+        while stack:
+            cur = stack.pop()
+            for child in ast.iter_child_nodes(cur):
+                parents[id(child)] = cur
+                if not isinstance(child, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef, ast.Lambda)):
+                    stack.append(child)
+
+        for n in ast.walk(node):
+            # only this function's own statements: nodes inside nested
+            # defs were never parented above and are checked as their own
+            # reachable functions
+            if n is not node and id(n) not in parents:
+                continue
+            if isinstance(n, (ast.If, ast.While)):
+                hot = sorted(_names_in(n.test) & traced_params)
+                if hot:
+                    kind = "if" if isinstance(n, ast.If) else "while"
+                    out.append(Finding(
+                        rule=self.id, path=mod.path, line=n.lineno,
+                        message=f"`{kind}` on traced argument(s) "
+                                f"{', '.join(hot)} in {fi.qualname}{via}: "
+                                f"data-dependent control flow concretizes "
+                                f"or recompiles per value",
+                        hint="use jnp.where / jax.lax.cond, or declare the "
+                             "argument in static_argnames if it is truly "
+                             "configuration",
+                        anchor=anchor(
+                            f"{fi.qualname}:branch:{'+'.join(hot)}")))
+            elif isinstance(n, ast.Call):
+                d = dotted(n.func)
+                if d in ("int", "float", "bool") and n.args \
+                        and not isinstance(n.args[0], ast.Constant):
+                    out.append(Finding(
+                        rule=self.id, path=mod.path, line=n.lineno,
+                        message=f"{d}() concretization inside traced "
+                                f"scope of {fi.qualname}{via}",
+                        hint="keep values as jnp arrays inside the trace; "
+                             "convert on host after fetching",
+                        anchor=anchor(f"{fi.qualname}:cast:{d}")))
+            elif isinstance(n, ast.For):
+                d = dotted(n.iter.func) if isinstance(n.iter, ast.Call) \
+                    else None
+                if d is not None and d.split(".")[-1] in (
+                        "items", "keys", "values"):
+                    out.append(Finding(
+                        rule=self.id, path=mod.path, line=n.lineno,
+                        message=f"statement-level `for` over "
+                                f"`.{d.split('.')[-1]}()` inside traced "
+                                f"scope of {fi.qualname}{via}: unrolls per "
+                                f"key and recompiles when the key set "
+                                f"varies",
+                        hint="use a dict comprehension over a fixed schema "
+                             "or jax.tree_util.tree_map",
+                        anchor=anchor(f"{fi.qualname}:dict-unroll")))
+            elif isinstance(n, ast.JoinedStr):
+                if _in_raise_or_assert(n, parents):
+                    continue
+                out.append(Finding(
+                    rule=self.id, path=mod.path, line=n.lineno,
+                    message=f"f-string built inside traced scope of "
+                            f"{fi.qualname}{via}: runs at trace time; as "
+                            f"a key it mints one program per distinct "
+                            f"string",
+                    hint="derive keys/labels on host (the serve bucket_tag "
+                         "pattern) and pass results in",
+                    anchor=anchor(f"{fi.qualname}:fstring")))
+        return out

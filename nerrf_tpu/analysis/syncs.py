@@ -1,0 +1,102 @@
+"""sync-in-hot-loop: device syncs inside host loops must be deliberate.
+
+`block_until_ready`, `jax.device_get`, `.item()` and the repo's own
+`sync_result` each fence the dispatch queue: inside a `for`/`while` loop
+they serialize host and device per iteration, which is exactly the
+idle-accelerator failure mode the tracing spine exists to expose
+(train_host_blocked_fraction).  A sync in a loop is sometimes the point —
+an eval loop fetching batch results, the warmup barrier, a bench timing
+step — so every deliberate site carries an inline
+``# nerrflint: ok[sync-in-hot-loop] why`` justification (or a baseline
+entry), and anything new fails tier-1 until someone writes down why the
+fence is intended.
+
+``allow`` exempts function qualnames wholesale (the constructor default
+covers the serve batch-close scorer, where the per-batch fetch IS the
+product), for embedders running the rule over other trees.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import FrozenSet, List
+
+from nerrf_tpu.analysis.astutil import dotted
+from nerrf_tpu.analysis.engine import Finding, Rule
+
+# deliberate per-iteration fetch points: the serve scorer's batch-close
+# fetch is the product (score → demux latency), not an accident
+DEFAULT_ALLOW = frozenset({
+    "MicroBatcher._score_batch",
+    "OnlineDetectionService._score_fn",
+})
+
+_SYNC_LAST = frozenset({"block_until_ready", "sync_result"})
+
+
+def _sync_call(call: ast.Call) -> str:
+    d = dotted(call.func)
+    if d is None:
+        return ""
+    last = d.split(".")[-1]
+    if last in _SYNC_LAST:
+        return last
+    if d in ("jax.device_get", "device_get"):
+        return "device_get"
+    if last == "item" and not call.args and not call.keywords:
+        return ".item()"
+    return ""
+
+
+class SyncInHotLoop(Rule):
+    id = "sync-in-hot-loop"
+    description = ("block_until_ready / device_get / .item() / sync_result "
+                   "inside for/while loops without a written justification")
+
+    def __init__(self, allow: FrozenSet[str] = DEFAULT_ALLOW) -> None:
+        self.allow = frozenset(allow)
+
+    def run(self, project: "Project") -> List[Finding]:  # noqa: F821
+        findings: List[Finding] = []
+        for mod in project.modules.values():
+            for fi in mod.functions:
+                if fi.qualname in self.allow:
+                    continue
+                findings.extend(self._check(mod, fi))
+        return findings
+
+    def _check(self, mod, fi) -> List[Finding]:
+        node = fi.node
+        if isinstance(node, ast.Lambda):
+            return []
+        out: List[Finding] = []
+        ordinals: dict = {}
+
+        def walk(n, in_loop: bool) -> None:
+            for child in ast.iter_child_nodes(n):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                      ast.Lambda)):
+                    continue  # their own FunctionInfo
+                loop = in_loop or isinstance(child, (ast.For, ast.While))
+                if in_loop and isinstance(child, ast.Call):
+                    name = _sync_call(child)
+                    if name:
+                        # ordinal suffix on repeats: anchors stay
+                        # line-number-free yet unique per site
+                        ordinals[name] = ordinals.get(name, 0) + 1
+                        anchor = f"{fi.qualname}:{name}"
+                        if ordinals[name] > 1:
+                            anchor += f"@{ordinals[name]}"
+                        out.append(Finding(
+                            rule=self.id, path=mod.path, line=child.lineno,
+                            message=f"{name} inside a loop in "
+                                    f"{fi.qualname}: fences the dispatch "
+                                    f"queue every iteration",
+                            hint="batch the fetch outside the loop, or "
+                                 "mark the sync deliberate with "
+                                 "`# nerrflint: ok[sync-in-hot-loop] why`",
+                            anchor=anchor))
+                walk(child, loop)
+
+        walk(node, False)
+        return out

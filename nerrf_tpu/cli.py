@@ -365,6 +365,28 @@ def cmd_trace(args) -> int:
 
 
 # --------------------------------------------------------------------------
+def cmd_lint(args) -> int:
+    """Static analysis over the package's own ASTs (nerrflint): jax-purity,
+    recompile-hazard, sync-in-hot-loop, lock-discipline, metrics-contract.
+    Same engine as scripts/nerrflint.py and the tier-1 gate
+    (tests/test_analysis.py); rule catalog in docs/static-analysis.md.
+    Deliberately NO jax import — safe on any host, including one with a
+    wedged accelerator tunnel."""
+    from nerrf_tpu.analysis.engine import main as lint_main
+
+    argv = []
+    if args.json:
+        argv.append("--json")
+    if args.list_rules:
+        argv.append("--list-rules")
+    for rid in args.rule or ():
+        argv += ["--rule", rid]
+    if args.baseline:
+        argv += ["--baseline", args.baseline]
+    return lint_main(argv)
+
+
+# --------------------------------------------------------------------------
 def cmd_status(args) -> int:
     inc = Path(args.incident)
     stages = {
@@ -892,6 +914,19 @@ def main(argv=None) -> int:
                    help="Chrome-trace JSON produced by --trace-out (or any "
                         "trace-event file)")
     p.set_defaults(fn=cmd_trace)
+
+    p = sub.add_parser("lint", help="static analysis over nerrf_tpu's own "
+                                    "ASTs (purity, recompile, sync, lock "
+                                    "discipline, metrics contract)")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable report")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule catalog and exit")
+    p.add_argument("--rule", action="append", default=None, metavar="ID",
+                   help="run only this rule (repeatable)")
+    p.add_argument("--baseline", default=None, metavar="FILE",
+                   help="suppression file (default: .nerrflint-baseline)")
+    p.set_defaults(fn=cmd_lint)
 
     p = sub.add_parser("doctor", help="diagnose the environment (deps, "
                                       "backend, toolchain, capture, sandbox)")

@@ -96,6 +96,7 @@ def _segment_sum_call(
     interpret: bool = False,
 ) -> jnp.ndarray:
     E, F = data.shape
+    # nerrflint: ok[recompile-hazard] num_segments is a static shape arg;
     if E == 0 or F == 0 or num_segments == 0:  # degenerate: nothing to tile
         return jnp.zeros((num_segments, F), data.dtype)
     ids = _pad_to(segment_ids.astype(jnp.int32).reshape(-1, 1), 0, _TE, -1)
@@ -161,6 +162,7 @@ def _segment_sum_sorted_call(
 ) -> jnp.ndarray:
     """Banded segment sum; ``segment_ids`` must be nondecreasing."""
     E, F = data.shape
+    # nerrflint: ok[recompile-hazard] num_segments is a static shape arg;
     if E == 0 or F == 0 or num_segments == 0:  # degenerate: nothing to tile
         return jnp.zeros((num_segments, F), data.dtype)
     n_pad = num_segments + ((-num_segments) % _TN)
@@ -442,6 +444,7 @@ def _sage_call(msg, dst_ids, src_by_dst, w_dst, src_ids, dst_by_src, w_src,
     be nondecreasing; ``msg`` must have ``num_nodes`` rows."""
     N, F = msg.shape
     E = dst_ids.shape[0]
+    # nerrflint: ok[recompile-hazard] num_nodes is a static shape arg;
     if E == 0 or F == 0 or num_nodes == 0:  # degenerate: nothing to tile
         return jnp.zeros((num_nodes, F), msg.dtype)
     n_pad = num_nodes + ((-num_nodes) % _TN)

@@ -70,7 +70,11 @@ class ModelManager:
         → (params, JointConfig, calibration, version)."""
         params, model_cfg, calibration, version = self.store.load(
             self.lineage)
-        self._version = version
+        # _version is otherwise only moved under the poll lock; boot
+        # usually runs before polling starts, but a CLI-poked manager can
+        # already be polling, so the write takes the same lock
+        with self._poll_lock:
+            self._version = version
         return params, model_cfg, calibration, version
 
     def attach(self, service) -> "ModelManager":
@@ -78,15 +82,21 @@ class ModelManager:
         back into `observe_shadow` from its scorer thread)."""
         self._service = service
         service.attach_manager(self)
-        if self._version is None:
-            self._version = service.live_version
-        elif service.live_version is None:
-            # the service was constructed from boot()'s params before any
-            # swap: stamp the booted version so results carry it from the
-            # first scored window
-            with service._swap_lock:
-                service._live_version = self._version
-        self._stamp_info(self._version)
+        # same discipline as boot(): _version moves only under the poll
+        # lock (a concurrent poll would race the stamp otherwise).  The
+        # nested swap-lock take matches the _apply→swap_params order, so
+        # the acquisition-order graph stays acyclic.
+        with self._poll_lock:
+            if self._version is None:
+                self._version = service.live_version
+            elif service.live_version is None:
+                # the service was constructed from boot()'s params before
+                # any swap: stamp the booted version so results carry it
+                # from the first scored window
+                with service._swap_lock:
+                    service._live_version = self._version
+            version = self._version
+        self._stamp_info(version)
         return self
 
     @property

@@ -202,6 +202,10 @@ class MicroBatcher:
     def _emit_batch(self, bucket: Bucket, reqs: List[WindowRequest],
                     cause: str) -> None:
         tag = bucket_tag(bucket)
+        with self._lock:
+            # stream threads mutate _live concurrently; the post-close
+            # depth must be a locked read, not a racy .get
+            depth = self._live.get(bucket, 0)
         with trace_span("serve_batch_close", bucket=tag, cause=cause,
                         windows=len(reqs)):
             self._reg.counter_inc(
@@ -212,7 +216,7 @@ class MicroBatcher:
                 buckets=OCCUPANCY_BUCKETS, labels={"bucket": tag},
                 help="real windows packed per shared device batch")
             self._reg.gauge_set(
-                "serve_queue_depth", self._live.get(bucket, 0),
+                "serve_queue_depth", depth,
                 labels={"bucket": tag},
                 help="windows pending per capacity bucket")
         self._ready.put((bucket, reqs, cause))

@@ -187,7 +187,8 @@ def train_elastic(
             batch = {k: jnp.asarray(v[idx]) for k, v in train_ds.arrays.items()}
             state, loss, aux, _ = train_step(state, batch, step_rng)
         if t_start is None:
-            sync_result(loss)
+            # nerrflint: ok[sync-in-hot-loop] step-0 compile barrier:
+            sync_result(loss)  # excludes compile from steps/s timing
             t_start = time.perf_counter()
         if fault is not None:
             fault(step)

@@ -135,6 +135,7 @@ def run_experiment(name_or_path: str, out_dir: str | Path,
             batch = shard_batch(mesh, {k: v[idx] for k, v in train_ds.arrays.items()})
             state, loss, aux, rng = step(state, batch, rng)
             if i == 0:
+                # nerrflint: ok[sync-in-hot-loop] step-0 compile barrier
                 sync_result(loss)
                 t_start = time.perf_counter()
         sync_result(state.params)

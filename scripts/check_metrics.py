@@ -1,155 +1,34 @@
 #!/usr/bin/env python3
-"""Static metrics lint: naming/typing/help rules for every registry call.
+"""Static metrics lint — thin shim over the nerrflint engine's rule.
 
-Greps the codebase (``nerrf_tpu/``, ``bench.py``, ``benchmarks/``) for every
-metric name passed to a ``MetricsRegistry`` method (``counter_inc``,
-``gauge_set``, ``histogram_observe`` — the DEFAULT_REGISTRY wiring and any
-local registry alike) and fails on:
-
-  * counters whose name does not end in ``_total`` (Prometheus convention —
-    a counter without it reads as a gauge on every dashboard);
-  * one name registered under conflicting types (the registry renders one
-    ``# TYPE`` block per name; a clash silently splits or corrupts series);
-  * metric names never registered with ``help=`` text at any call site
-    (an unexplained series is a dashboard mystery).
-
-Names passed as UPPER_CASE module constants are resolved from the same
-file's literal assignment (the tracing spine registers its histogram this
-way).  Runs as a tier-1 test (tests/test_metrics_lint.py) and standalone:
+The implementation moved to ``nerrf_tpu/analysis/metrics_contract.py``
+(the ``metrics-contract`` rule of ``scripts/nerrflint.py``); this entry
+point keeps the historical surface working unchanged:
 
     python scripts/check_metrics.py [--list]
+
+Same checks as always: counters end in ``_total``, one type per name,
+help text required somewhere, contract names (REQUIRED) still registered.
+``scan``/``lint``/``check_required`` stay importable from here for
+tests/test_metrics_lint.py and any operator tooling built on them.
 """
 
 from __future__ import annotations
 
-import argparse
-import re
 import sys
 from pathlib import Path
 
-REPO = Path(__file__).resolve().parents[1]
-SCAN = ("nerrf_tpu", "bench.py", "benchmarks")
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
 
-# Contract metrics: names dashboards/alerts/docs depend on, which must
-# keep being registered SOMEWHERE in the codebase — deleting the last call
-# site would silently blank a dashboard panel.  (The model-lifecycle set
-# rides the registry subsystem: docs/model-lifecycle.md's runbook keys off
-# these exact names.)
-REQUIRED = (
-    "model_info",
-    "registry_swaps_total",
-    "registry_shadow_windows_total",
-    "registry_shadow_disagreement_rate",
-    "registry_shadow_score_drift",
-    "registry_shadow_vetoes_total",
-    "registry_promotions_total",
-    "serve_windows_scored_total",
-    "serve_recompiles_total",
+from nerrf_tpu.analysis.metrics_contract import (  # noqa: E402,F401
+    REPO,
+    REQUIRED,
+    SCAN,
+    check_required,
+    lint,
+    main,
+    scan,
 )
-
-_CALL = re.compile(
-    r"\.(counter_inc|gauge_set|histogram_observe)\(\s*"
-    r"(?:['\"](?P<lit>[A-Za-z0-9_:]+)['\"]|(?P<const>[A-Z][A-Z0-9_]*))")
-_TYPE_OF = {"counter_inc": "counter", "gauge_set": "gauge",
-            "histogram_observe": "histogram"}
-
-
-def _call_chunk(text: str, start: int) -> str:
-    """The call's argument text, from its opening paren to the balanced
-    close (string-literal parens would only over-extend the chunk, which
-    is harmless for the ``help=`` presence check)."""
-    i = text.index("(", start)
-    depth = 0
-    for j in range(i, min(len(text), i + 4000)):
-        if text[j] == "(":
-            depth += 1
-        elif text[j] == ")":
-            depth -= 1
-            if depth == 0:
-                return text[i:j + 1]
-    return text[i:i + 4000]
-
-
-def _resolve_const(text: str, name: str) -> str | None:
-    m = re.search(rf"^{name}\s*=\s*['\"]([A-Za-z0-9_:]+)['\"]",
-                  text, re.MULTILINE)
-    return m.group(1) if m else None
-
-
-def scan(repo: Path = REPO) -> dict[str, dict]:
-    """name → {"types": {type: [sites]}, "has_help": bool, "sites": [...]}"""
-    metrics: dict[str, dict] = {}
-    files: list[Path] = []
-    for entry in SCAN:
-        p = repo / entry
-        files.extend(sorted(p.rglob("*.py")) if p.is_dir() else [p])
-    for path in files:
-        text = path.read_text()
-        rel = path.relative_to(repo)
-        for m in _CALL.finditer(text):
-            name = m.group("lit")
-            if name is None:
-                name = _resolve_const(text, m.group("const"))
-                if name is None:
-                    continue  # not a literal-backed constant: out of scope
-            line = text.count("\n", 0, m.start()) + 1
-            site = f"{rel}:{line}"
-            mtype = _TYPE_OF[m.group(1)]
-            rec = metrics.setdefault(
-                name, {"types": {}, "has_help": False, "sites": []})
-            rec["types"].setdefault(mtype, []).append(site)
-            rec["sites"].append(site)
-            if re.search(r"\bhelp\s*=", _call_chunk(text, m.start())):
-                rec["has_help"] = True
-    return metrics
-
-
-def lint(metrics: dict[str, dict]) -> list[str]:
-    errors = []
-    for name, rec in sorted(metrics.items()):
-        sites = ", ".join(rec["sites"][:3])
-        if "counter" in rec["types"] and not name.endswith("_total"):
-            errors.append(
-                f"counter {name!r} missing the _total suffix ({sites})")
-        if len(rec["types"]) > 1:
-            detail = "; ".join(
-                f"{t} at {', '.join(s[:2])}"
-                for t, s in sorted(rec["types"].items()))
-            errors.append(
-                f"metric {name!r} registered under conflicting types: "
-                f"{detail}")
-        if not rec["has_help"]:
-            errors.append(
-                f"metric {name!r} never registered with help text ({sites})")
-    return errors
-
-
-def check_required(metrics: dict[str, dict],
-                   required=REQUIRED) -> list[str]:
-    return [f"contract metric {name!r} is no longer registered anywhere "
-            f"(a dashboard/runbook depends on it)"
-            for name in required if name not in metrics]
-
-
-def main(argv=None) -> int:
-    ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("--list", action="store_true",
-                    help="print the metric inventory and exit")
-    args = ap.parse_args(argv)
-    metrics = scan()
-    if args.list:
-        for name, rec in sorted(metrics.items()):
-            types = "/".join(sorted(rec["types"]))
-            print(f"{name:<36} {types:<10} "
-                  f"{'help' if rec['has_help'] else 'NO HELP':<8} "
-                  f"{len(rec['sites'])} site(s)")
-    errors = lint(metrics) + check_required(metrics)
-    for e in errors:
-        print(f"check_metrics: {e}", file=sys.stderr)
-    if not errors:
-        print(f"check_metrics: {len(metrics)} metric names clean")
-    return 1 if errors else 0
-
 
 if __name__ == "__main__":
     sys.exit(main())

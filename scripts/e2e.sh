@@ -42,6 +42,11 @@ trap '[ -n "${SERVER_PID:-}" ] && kill "$SERVER_PID" 2>/dev/null || true; rm -rf
 
 cd "$(dirname "$0")/.."
 
+# pre-flight: the repo's static analysis must be clean before any servers
+# or daemons come up — an unbaselined finding fails in seconds here
+# instead of surfacing as a race/recompile mid-stream
+python scripts/nerrflint.py
+
 if [ "$MODE" = "live" ]; then
     make -C native build/nerrf-trackerd >/dev/null
     rc=0

@@ -1,0 +1,23 @@
+#!/usr/bin/env python3
+"""Standalone nerrflint entry point (the chip-queue pre-flight surface).
+
+Thin shim over ``nerrf_tpu.analysis.engine`` — same flags, same exit
+codes (0 clean, 1 unbaselined findings, 2 usage/baseline errors):
+
+    python scripts/nerrflint.py [--json] [--list-rules] [--rule ID]
+
+Runs the full ruleset over ``nerrf_tpu/`` in seconds on CPU (no jax
+import), so ``scripts/e2e.sh`` and ``scripts/tpu_queue.sh`` fail fast on
+analysis errors instead of burning chip time.  Rule catalog and
+suppression workflow: docs/static-analysis.md.
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+from nerrf_tpu.analysis.engine import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -22,6 +22,14 @@
 cd "$(dirname "$0")/.."
 log() { echo "[queue $(date +%H:%M:%S)] $*" >> /tmp/tpu_queue.log; }
 log "watcher started (r5)"
+# pre-flight: static analysis (purity/recompile/lock/metrics rules) runs
+# in seconds on CPU with no jax import — a queue that would burn hours of
+# chip time on code with a known recompile or race hazard fails here
+if ! python scripts/nerrflint.py > /tmp/nerrflint.log 2>&1; then
+  log "PRE-FLIGHT FAIL: nerrflint found unbaselined findings (/tmp/nerrflint.log)"
+  exit 1
+fi
+log "pre-flight: nerrflint clean"
 # the gate must exercise the full enumerate->compile->execute path: the
 # relay has been seen half-up (enumeration answering, remote_compile
 # refusing), which passes an enumeration-only check and then wedges the

@@ -16,6 +16,13 @@
 cd "$(dirname "$0")/.."
 log() { echo "[queue $(date +%H:%M:%S)] $*" >> /tmp/tpu_queue.log; }
 log "continuation watcher started (r5b: bench-first reorder)"
+# same pre-flight as tpu_queue.sh: fail fast on static-analysis errors
+# instead of burning the tunnel window
+if ! python scripts/nerrflint.py > /tmp/nerrflint.log 2>&1; then
+  log "PRE-FLIGHT FAIL: nerrflint found unbaselined findings (/tmp/nerrflint.log)"
+  exit 1
+fi
+log "pre-flight: nerrflint clean"
 tpu_ok() {
   python -c "
 import sys

@@ -1,0 +1,481 @@
+"""nerrflint: the static-analysis tier-1 gate + the analyzer's own tests.
+
+Two jobs:
+
+  * ``test_repo_is_clean`` runs the FULL ruleset over ``nerrf_tpu/`` with
+    the checked-in baseline — so every future PR is analyzed on every
+    test run, and an unjustified purity/recompile/sync/lock/metrics
+    violation fails tier-1 the day it lands.
+  * fixture tests per rule (positive AND negative), baseline round-trip,
+    inline suppression, ``--json`` schema stability, and the cross-file
+    call-graph purity case — the analyzer itself is code too.
+"""
+
+import json
+import subprocess
+import sys
+import textwrap
+import time
+from pathlib import Path
+
+from nerrf_tpu.analysis import analyze
+from nerrf_tpu.analysis.astutil import Project, collect_files
+from nerrf_tpu.analysis.locks import LockDiscipline
+from nerrf_tpu.analysis.purity import JaxPurity
+from nerrf_tpu.analysis.recompile import RecompileHazard
+from nerrf_tpu.analysis.syncs import SyncInHotLoop
+
+RULE_IDS = {"jax-purity", "recompile-hazard", "sync-in-hot-loop",
+            "lock-discipline", "metrics-contract"}
+
+
+def _fixture(tmp_path: Path, files: dict) -> Path:
+    for rel, body in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(body))
+    return tmp_path
+
+
+def _run(tmp_path: Path, files: dict, rules) -> list:
+    _fixture(tmp_path, files)
+    return analyze(tmp_path, ("pkg",), rules).findings
+
+
+# -- the tier-1 gate ----------------------------------------------------------
+
+
+def test_repo_is_clean(repo_root):
+    """The full ruleset over nerrf_tpu/ with the checked-in baseline:
+    zero unbaselined findings, and fast enough (<10s) to run everywhere
+    (no jax import — the engine is stdlib-only by design)."""
+    t0 = time.monotonic()
+    r = subprocess.run(
+        [sys.executable, str(repo_root / "scripts" / "nerrflint.py")],
+        capture_output=True, text=True, timeout=60, cwd=repo_root)
+    elapsed = time.monotonic() - t0
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "clean" in r.stdout
+    assert elapsed < 10.0, f"nerrflint took {elapsed:.1f}s (budget 10s)"
+
+
+def test_list_rules_catalog(repo_root):
+    r = subprocess.run(
+        [sys.executable, str(repo_root / "scripts" / "nerrflint.py"),
+         "--list-rules"], capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0
+    for rid in RULE_IDS:
+        assert rid in r.stdout
+    # unknown rule ids are a usage error, not a silent no-op
+    r = subprocess.run(
+        [sys.executable, str(repo_root / "scripts" / "nerrflint.py"),
+         "--rule", "no-such-rule"], capture_output=True, text=True,
+        timeout=60)
+    assert r.returncode == 2
+
+
+def test_json_schema_stable(repo_root):
+    """The --json document's top-level keys are a contract (queue tooling
+    parses it); additions bump `schema`."""
+    r = subprocess.run(
+        [sys.executable, str(repo_root / "scripts" / "nerrflint.py"),
+         "--json"], capture_output=True, text=True, timeout=60)
+    doc = json.loads(r.stdout)
+    assert set(doc) == {"schema", "ok", "files", "elapsed_sec", "rules",
+                        "findings", "suppressed", "stale_baseline", "errors"}
+    assert doc["schema"] == 1
+    assert {ru["id"] for ru in doc["rules"]} == RULE_IDS
+    assert doc["ok"] is True
+    for f in doc["suppressed"]:
+        assert set(f) == {"rule", "path", "line", "message", "hint",
+                          "anchor"}
+
+
+def test_cli_lint_subcommand(capsys):
+    from nerrf_tpu.cli import main
+
+    assert main(["lint", "--list-rules"]) == 0
+    out = capsys.readouterr().out
+    assert "jax-purity" in out and "lock-discipline" in out
+
+
+# -- jax-purity ---------------------------------------------------------------
+
+
+def test_purity_flags_host_clock_in_decorated_jit(tmp_path):
+    found = _run(tmp_path, {"pkg/mod.py": """\
+        import time
+
+        import jax
+
+        @jax.jit
+        def step(x):
+            t0 = time.perf_counter()
+            return x + t0
+        """}, [JaxPurity()])
+    assert len(found) == 1
+    f = found[0]
+    assert f.rule == "jax-purity" and "time.perf_counter" in f.message
+    assert f.path == "pkg/mod.py" and f.anchor == "step:time.perf_counter"
+
+
+def test_purity_cross_file_call_graph(tmp_path):
+    """An effect two modules away from the jit point is still found: the
+    walk follows `from pkg.helpers import emit` through the import table."""
+    found = _run(tmp_path, {
+        "pkg/helpers.py": """\
+            def emit(x):
+                print(x)
+                return x
+            """,
+        "pkg/model.py": """\
+            import jax
+
+            from pkg.helpers import emit
+
+            def step(x):
+                return emit(x) + 1
+
+            fast = jax.jit(step)
+            """}, [JaxPurity()])
+    assert len(found) == 1
+    f = found[0]
+    assert f.path == "pkg/helpers.py" and "print" in f.message
+    assert "reached from step" in f.message
+
+
+def test_purity_flags_metrics_and_span_in_scan_body(tmp_path):
+    found = _run(tmp_path, {"pkg/mod.py": """\
+        import jax
+
+        from nerrf_tpu.tracing import span
+
+        def body(carry, x):
+            with span("inner"):
+                REG.counter_inc("steps_total", 1)
+            return carry, x
+
+        def run(xs):
+            return jax.lax.scan(body, 0, xs)
+        """}, [JaxPurity()])
+    kinds = {f.anchor for f in found}
+    assert "body:nerrf_tpu.tracing.span" in kinds  # canonicalized alias
+    assert "body:REG.counter_inc" in kinds
+
+
+def test_purity_sees_through_import_aliases(tmp_path):
+    """`import time as _time` must not smuggle a host clock past the
+    prefix checks: effect names canonicalize through the import table."""
+    found = _run(tmp_path, {"pkg/mod.py": """\
+        import time as _time
+
+        import jax
+
+        def step(x):
+            _time.sleep(0.1)
+            return x
+
+        fast = jax.jit(step)
+        """}, [JaxPurity()])
+    assert len(found) == 1 and "time.sleep" in found[0].message
+
+
+def test_purity_duplicate_effects_get_distinct_anchors(tmp_path):
+    """A suppressed first host-clock call must not hide a newly added
+    second one: same-effect sites in one function take ordinal anchors."""
+    _fixture(tmp_path, {"pkg/mod.py": """\
+        import time
+
+        import jax
+
+        @jax.jit
+        def step(x):
+            # nerrflint: ok[jax-purity] known trace-time stamp
+            t0 = time.perf_counter()
+            t1 = time.perf_counter()
+            return x + t0 + t1
+        """})
+    report = analyze(tmp_path, ("pkg",), [JaxPurity()])
+    assert len(report.suppressed) == 1
+    assert len(report.findings) == 1
+    assert report.findings[0].anchor.startswith("step:time.perf_counter")
+    assert report.findings[0].anchor != report.suppressed[0].anchor
+
+
+def test_metrics_contract_inline_suppression_outside_ast_scan(tmp_path):
+    """metrics-contract reports into bench.py/benchmarks/ (never AST-
+    parsed); inline markers there must still work via the disk fallback."""
+    from nerrf_tpu.analysis.metrics_contract import MetricsContract
+
+    _fixture(tmp_path, {
+        "nerrf_tpu/__init__.py": "",
+        "bench.py": "",
+        "benchmarks/run_x.py": """\
+            # nerrflint: ok[metrics-contract] scratch gauge, not dashboarded
+            REG.gauge_set("bench_scratch", 1.0)
+            """})
+    report = analyze(tmp_path, ("nerrf_tpu",),
+                     [MetricsContract(required=())])
+    assert report.findings == [] and len(report.suppressed) == 1
+
+
+def test_purity_quiet_on_pure_jit_and_host_effects(tmp_path):
+    found = _run(tmp_path, {"pkg/mod.py": """\
+        import time
+
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def step(x):
+            return jnp.tanh(x) * 2
+
+        def host_loop(xs):
+            t0 = time.perf_counter()   # host side: fine
+            print(len(xs))
+            return [step(x) for x in xs], time.perf_counter() - t0
+        """}, [JaxPurity()])
+    assert found == []
+
+
+# -- recompile-hazard ---------------------------------------------------------
+
+
+def test_recompile_flags_branch_on_traced_arg(tmp_path):
+    found = _run(tmp_path, {"pkg/mod.py": """\
+        import jax
+
+        @jax.jit
+        def f(x):
+            if x > 0:
+                return x
+            return -x
+        """}, [RecompileHazard()])
+    assert len(found) == 1
+    assert "data-dependent control flow" in found[0].message
+    assert found[0].anchor == "f:branch:x"
+
+
+def test_recompile_quiet_on_static_argnames(tmp_path):
+    found = _run(tmp_path, {"pkg/mod.py": """\
+        from functools import partial
+
+        import jax
+
+        @partial(jax.jit, static_argnames=("mode",))
+        def f(x, mode):
+            if mode:
+                return x
+            return x * 2
+        """}, [RecompileHazard()])
+    assert found == []
+
+
+def test_recompile_flags_cast_fstring_and_dict_unroll(tmp_path):
+    found = _run(tmp_path, {"pkg/mod.py": """\
+        import jax
+
+        @jax.jit
+        def f(batch):
+            total = 0
+            for k, v in batch.items():
+                total = total + v
+            n = int(total.sum())
+            tag = f"bucket{n}"
+            return total
+        """}, [RecompileHazard()])
+    msgs = " | ".join(f.message for f in found)
+    assert "for` over `.items()" in msgs
+    assert "int() concretization" in msgs
+    assert "f-string" in msgs
+
+
+def test_recompile_quiet_on_comprehension_and_raise_fstring(tmp_path):
+    found = _run(tmp_path, {"pkg/mod.py": """\
+        import jax
+
+        @jax.jit
+        def f(batch, n: int = 2):
+            assert n > 0, f"static {n}"
+            out = {k: v * 2 for k, v in batch.items()}
+            if n > 1:
+                raise ValueError(f"bad {n}")
+            return out
+        """}, [RecompileHazard()])
+    # the f-strings are on raise/assert paths; the dict COMPREHENSION is
+    # the supported idiom; the `if` on n... is a real branch finding
+    assert [f for f in found if "f-string" in f.message] == []
+    assert [f for f in found if ".items()" in f.message] == []
+
+
+# -- sync-in-hot-loop ---------------------------------------------------------
+
+
+_SYNC_SRC = {"pkg/mod.py": """\
+    def pump(xs):
+        out = []
+        for x in xs:
+            out.append(x.block_until_ready())
+        return out
+
+    def once(x):
+        return x.block_until_ready()
+    """}
+
+
+def test_sync_flags_loop_fence_not_single_fetch(tmp_path):
+    found = _run(tmp_path, _SYNC_SRC, [SyncInHotLoop(allow=frozenset())])
+    assert len(found) == 1
+    assert found[0].anchor == "pump:block_until_ready"
+    assert "once" not in found[0].message
+
+
+def test_sync_allowlist_exempts_function(tmp_path):
+    found = _run(tmp_path, _SYNC_SRC,
+                 [SyncInHotLoop(allow=frozenset({"pump"}))])
+    assert found == []
+
+
+def test_sync_inline_suppression_with_reason(tmp_path):
+    _fixture(tmp_path, {"pkg/mod.py": """\
+        def pump(xs):
+            out = []
+            for x in xs:
+                # nerrflint: ok[sync-in-hot-loop] bench: timed fetch
+                out.append(x.block_until_ready())
+            return out
+        """})
+    report = analyze(tmp_path, ("pkg",), [SyncInHotLoop(allow=frozenset())])
+    assert report.findings == [] and len(report.suppressed) == 1
+
+
+# -- lock-discipline ----------------------------------------------------------
+
+
+_BOX_SRC = {"pkg/box.py": """\
+    import threading
+
+    class Box:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._d = {}
+            self._ptr = None
+
+        def put(self, k, v):
+            with self._lock:
+                self._d[k] = v
+                self._ptr = v
+
+        def racy_get(self, k):
+            return self._d.get(k)
+
+        def racy_set(self):
+            self._ptr = 3
+
+        def snapshot(self):
+            return self._ptr
+
+        def _locked_mutate(self):
+            self._d["x"] = 1
+
+        def poll(self):
+            with self._lock:
+                self._locked_mutate()
+    """}
+
+
+def test_lock_discipline_reads_writes_and_propagation(tmp_path):
+    found = _run(tmp_path, _BOX_SRC, [LockDiscipline(scope=None)])
+    anchors = {f.anchor for f in found}
+    # container read + pointer write outside the lock: flagged
+    assert "Box.racy_get:_d:read" in anchors
+    assert "Box.racy_set:_ptr:rebind" in anchors
+    # rebound-only pointer READ is a GIL-atomic snapshot: allowed
+    assert not any(a.startswith("Box.snapshot") for a in anchors)
+    # _locked_mutate runs under poll()'s lock (entry-held propagation)
+    assert not any(a.startswith("Box._locked_mutate") for a in anchors)
+    assert len(found) == 2
+
+
+def test_lock_order_cycle_detected(tmp_path):
+    found = _run(tmp_path, {"pkg/pair.py": """\
+        import threading
+
+        class A:
+            def __init__(self, other):
+                self._a = threading.Lock()
+                self.other = other
+
+            def ma(self):
+                with self._a:
+                    self.other.poke_b()
+
+            def grab_a(self):
+                with self._a:
+                    return 1
+
+        class B:
+            def __init__(self, peer):
+                self._b = threading.Lock()
+                self.peer = peer
+
+            def poke_b(self):
+                with self._b:
+                    self.peer.grab_a()
+        """}, [LockDiscipline(scope=None)])
+    cycles = [f for f in found if f.anchor.startswith("cycle:")]
+    assert len(cycles) == 1
+    assert "A._a" in cycles[0].message and "B._b" in cycles[0].message
+
+
+def test_lock_inventory_covers_the_threaded_planes(repo_root):
+    """The module-level lock inventory the rule is built on names the real
+    serve/registry/observability locks."""
+    proj = Project(repo_root, collect_files(repo_root, ("nerrf_tpu",)))
+    inv = LockDiscipline().inventory(proj)
+    assert "_lock" in inv["nerrf_tpu/serve/batcher.py:MicroBatcher"]
+    assert "_poll_lock" in inv["nerrf_tpu/registry/manager.py:ModelManager"]
+    assert "_swap_lock" in \
+        inv["nerrf_tpu/serve/service.py:OnlineDetectionService"]
+    assert "_lock" in inv["nerrf_tpu/observability.py:MetricsRegistry"]
+    assert "_lock" in inv["nerrf_tpu/registry/guardrails.py:ShadowStats"]
+
+
+# -- baseline round-trip ------------------------------------------------------
+
+
+def test_baseline_suppresses_then_goes_stale(tmp_path):
+    _fixture(tmp_path, _BOX_SRC)
+    first = analyze(tmp_path, ("pkg",), [LockDiscipline(scope=None)])
+    assert len(first.findings) == 2
+
+    bl = tmp_path / "bl.txt"
+    bl.write_text("".join(
+        f"{f.key}  # accepted: single-threaded caller owns Box here\n"
+        for f in first.findings))
+    second = analyze(tmp_path, ("pkg",), [LockDiscipline(scope=None)],
+                     baseline_path=bl)
+    assert second.ok and second.findings == []
+    assert len(second.suppressed) == 2 and second.stale == []
+
+    # fix one finding → its entry is reported stale (keeps the file honest)
+    src = (tmp_path / "pkg" / "box.py").read_text()
+    (tmp_path / "pkg" / "box.py").write_text(src.replace(
+        "def racy_set(self):\n        self._ptr = 3",
+        "def racy_set(self):\n        with self._lock:\n"
+        "            self._ptr = 3"))
+    third = analyze(tmp_path, ("pkg",), [LockDiscipline(scope=None)],
+                    baseline_path=bl)
+    assert third.findings == []
+    assert third.stale == ["lock-discipline pkg/box.py "
+                           "Box.racy_set:_ptr:rebind"]
+
+
+def test_baseline_requires_justification(tmp_path):
+    _fixture(tmp_path, _BOX_SRC)
+    bl = tmp_path / "bl.txt"
+    bl.write_text("lock-discipline pkg/box.py Box.racy_get:_d:read\n")
+    report = analyze(tmp_path, ("pkg",), [LockDiscipline(scope=None)],
+                     baseline_path=bl)
+    assert not report.ok
+    assert any("no justification" in e for e in report.errors)

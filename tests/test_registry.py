@@ -470,6 +470,33 @@ def test_manager_follows_promote_and_rollback_pointer(tmp_path):
         svc.stop(drain=False)
 
 
+def test_boot_and_attach_serialize_with_the_poll_lock(tmp_path):
+    """Regression (nerrflint lock-discipline): boot() and attach() used to
+    write `_version` bare while the poll thread moves it under
+    `_poll_lock`.  Both must now serialize against an in-flight poll — a
+    held poll lock blocks them, and release lets them land the stamp."""
+    from nerrf_tpu.train.checkpoint import save_checkpoint
+
+    store = ModelRegistry(tmp_path / "registry")
+    ck = tmp_path / "src"
+    save_checkpoint(ck, _leaf_params(0.5), JointConfig().small)
+    store.publish("det", ck)
+    store.promote("det", 1)
+    mgr = ModelManager(store, "det", cfg=RegistryConfig(poll_sec=60.0),
+                       registry=MetricsRegistry(namespace="test"))
+    mgr._poll_lock.acquire()
+    try:
+        t = threading.Thread(target=mgr.boot, daemon=True)
+        t.start()
+        t.join(timeout=0.5)
+        assert t.is_alive(), "boot() must wait for the poll lock"
+    finally:
+        mgr._poll_lock.release()
+    t.join(timeout=5.0)
+    assert not t.is_alive()
+    assert mgr.live_version == 1
+
+
 def test_manager_shadow_auto_promotes_agreeing_candidate(tmp_path):
     """A candidate that scores identically passes every guardrail: the
     manager promotes it in the REGISTRY (LIVE repoints) and swaps."""

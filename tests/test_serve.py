@@ -243,6 +243,34 @@ def test_batcher_packs_same_bucket_cross_stream_deterministically():
                              "cause": "occupancy"}) == 1
 
 
+def test_queue_depth_gauge_is_locked_post_close_count():
+    """Regression (nerrflint lock-discipline): `_emit_batch` used to read
+    `_live` without the batcher lock while stream threads mutate it.  The
+    post-close queue-depth gauge must equal the locked count of windows
+    still pending after the batch was assembled."""
+    from nerrf_tpu.serve.config import bucket_tag
+
+    cfg = ServeConfig(buckets=(BUCKET_B,), batch_size=4,
+                      batch_close_sec=10.0)
+    reg = MetricsRegistry(namespace="test")
+    mb = MicroBatcher(score_fn=lambda b: np.zeros(b["node_mask"].shape),
+                      cfg=cfg, registry=reg)
+    mb.mark_warm(BUCKET_B)
+    now = time.perf_counter()
+    for i in range(5):
+        sample = {"node_mask": np.zeros(BUCKET_B[0], np.bool_),
+                  "node_type": np.zeros(BUCKET_B[0], np.int32),
+                  "node_key": np.zeros(BUCKET_B[0], np.int64)}
+        mb.submit(WindowRequest(stream="s", window_idx=i, lo_ns=0, hi_ns=1,
+                                bucket=BUCKET_B, sample=sample, t_admit=now,
+                                deadline=now + 10))
+    # occupancy close takes 4 of the 5; the gauge must show the 1 leftover
+    assert mb.drain_once() == 1
+    assert reg.value("serve_queue_depth",
+                     labels={"bucket": bucket_tag(BUCKET_B)}) == 1.0
+    assert mb.queue_depth(BUCKET_B) == 1
+
+
 # -- slow-consumer isolation --------------------------------------------------
 
 def test_stalled_stream_cannot_delay_another_buckets_batch_close():
