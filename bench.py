@@ -545,6 +545,7 @@ def main() -> None:
     def _serve():
         # 2 streams, ~5 s of serving through the full wire path
         def surface(r):
+            slo_streams = (r.get("slo") or {}).get("per_stream") or {}
             return {
                 "streams": r.get("streams"),
                 "events_per_sec": r.get("value"),
@@ -554,6 +555,15 @@ def main() -> None:
                 "recompiles_after_warmup": r.get("recompiles_after_warmup"),
                 "parity_bit_identical":
                     r.get("parity", {}).get("bit_identical_to_model_detect"),
+                # SLO plane: the worst per-stream trailing p99 (the number
+                # an SLO dashboard alerts on) + the flight smoke verdicts
+                "slo_worst_stream_p99_ms": max(
+                    (s.get("p99_ms") for s in slo_streams.values()
+                     if s.get("p99_ms") is not None), default=None),
+                "slo_breaches": sum(
+                    s.get("breaches", 0) for s in slo_streams.values()),
+                "flight_bundles": (r.get("flight") or {}).get("bundles"),
+                "flight_doctor_ok": (r.get("flight") or {}).get("doctor_ok"),
                 "backend": r.get("backend"),
                 "smoke": r.get("smoke"),
                 "provenance": r.get("provenance"),

@@ -43,6 +43,7 @@ def run(streams: int = 8, sim_seconds: float = 90.0,
     import jax
 
     from nerrf_tpu.data.synth import SimConfig, simulate_trace
+    from nerrf_tpu.flight.journal import EventJournal
     from nerrf_tpu.ingest.service import TraceReplayServer, TrackerClient
     from nerrf_tpu.models import JointConfig, NerrfNet
     from nerrf_tpu.observability import MetricsRegistry
@@ -66,9 +67,12 @@ def run(streams: int = 8, sim_seconds: float = 90.0,
     model = NerrfNet(JointConfig().small)
     params = init_untrained_params(model, cfg)
     registry = MetricsRegistry(namespace="bench")
+    # isolated journal: the flight smoke leg below must see exactly THIS
+    # run's batch-close records, not another in-process user's
+    journal = EventJournal(capacity=8192, registry=registry)
     window_log: list = []
     svc = OnlineDetectionService(params, model, cfg=cfg, registry=registry,
-                                 window_log=window_log)
+                                 window_log=window_log, journal=journal)
     t0 = time.perf_counter()
     svc.start(log=log)
     warmup_wall = round(time.perf_counter() - t0, 1)
@@ -119,6 +123,65 @@ def run(streams: int = 8, sim_seconds: float = 90.0,
         srv.stop()
     svc.stop()
 
+    # ---- flight-recorder smoke leg -----------------------------------------
+    # A deliberately injected p99 latency spike and a drop burst must each
+    # produce exactly ONE rate-limited bundle, the spike bundle's journal
+    # tail must contain the offending window's batch-close record, and
+    # `nerrf doctor` must reconstruct the timeline from the bundle alone.
+    import shutil
+    import tempfile
+
+    from nerrf_tpu.flight import FlightConfig, FlightRecorder
+    from nerrf_tpu.flight.doctor import format_report, read_bundle
+
+    flight_dir = tempfile.mkdtemp(prefix="nerrf-flight-smoke-")
+    deadline = cfg.window_deadline_sec
+    exemplar_trace, _ = svc.slo.exemplar("s0")
+    recorder = FlightRecorder(
+        FlightConfig(out_dir=flight_dir, p99_breach_sec=deadline,
+                     p99_min_count=8, min_interval_sec=300.0,
+                     drop_burst_n=10, drop_burst_sec=5.0),
+        registry=registry, journal=journal, slo=svc.slo,
+        info=svc.flight_info, log=log)
+    # latency spike on the stream's worst REAL window: every observation
+    # past min_count breaches trailing p99, but the rate limit admits one
+    for _ in range(16):
+        recorder.observe_window("s0", exemplar_trace, deadline * 5.0)
+    # drop burst: a run of admission drops inside the sliding window
+    for i in range(12):
+        journal.record("admission_drop", stream="s0", window_id=10_000 + i,
+                       trace_id=exemplar_trace, reason="backpressure",
+                       injected=True)
+    recorder.close()
+    flight = {"bundles": 0, "triggers": [], "doctor_ok": False,
+              "p99_bundle_has_offending_batch_close": False,
+              "suppressed": int(registry.value(
+                  "flight_triggers_suppressed_total",
+                  labels={"trigger": "p99_breach"}) + registry.value(
+                  "flight_triggers_suppressed_total",
+                  labels={"trigger": "drop_burst"}))}
+    try:
+        names = sorted(p for p in os.listdir(flight_dir)
+                       if p.startswith("bundle-"))
+        flight["bundles"] = len(names)
+        flight["triggers"] = sorted(n.rsplit("-", 1)[-1] for n in names)
+        doctor_ok = bool(names)
+        for name in names:
+            bundle = read_bundle(os.path.join(flight_dir, name))
+            report = format_report(bundle)
+            if bundle["missing"] or "incident timeline" not in report:
+                doctor_ok = False
+            if name.endswith("p99_breach"):
+                # the spike window's batch-close record is in the tail,
+                # joinable by its trace ID
+                flight["p99_bundle_has_offending_batch_close"] = any(
+                    r.kind == "batch_close"
+                    and exemplar_trace in r.data.get("trace_ids", [])
+                    for r in bundle["records"])
+        flight["doctor_ok"] = doctor_ok
+    finally:
+        shutil.rmtree(flight_dir, ignore_errors=True)
+
     tag = bucket_tag(tuple(bucket))
     lat_ms = sorted(1e3 * entry[2] for entry in window_log)
 
@@ -161,6 +224,11 @@ def run(streams: int = 8, sim_seconds: float = 90.0,
             "p50": pct(0.50), "p99": pct(0.99),
             "max": round(lat_ms[-1], 1) if lat_ms else None},
         "recompiles_after_warmup": int(recompiles),
+        # per-stream end-to-end SLO: exact trailing percentiles + exemplar
+        # trace IDs (the registry carries the same data as the
+        # nerrf_slo_e2e_seconds / nerrf_slo_budget_burn_ratio series)
+        "slo": {"metric": "nerrf_slo_e2e_seconds", **svc.slo.snapshot()},
+        "flight": flight,
         "warmup_seconds": {"wall": warmup_wall, **svc.warmup_seconds},
         "parity": {
             "stream": "s0",
@@ -199,7 +267,12 @@ def main(argv=None) -> int:
             f.write(json.dumps(result, indent=2) + "\n")
     ok = (result["parity"]["bit_identical_to_model_detect"]
           and result["recompiles_after_warmup"] == 0
-          and not result["stream_errors"])
+          and not result["stream_errors"]
+          # flight-recorder acceptance: the injected spike + drop burst
+          # produced exactly one bundle each, doctor-readable offline
+          and result["flight"]["bundles"] == 2
+          and result["flight"]["doctor_ok"]
+          and result["flight"]["p99_bundle_has_offending_batch_close"])
     return 0 if ok else 1
 
 

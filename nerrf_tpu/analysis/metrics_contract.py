@@ -48,6 +48,14 @@ REQUIRED = (
     "registry_promotions_total",
     "serve_windows_scored_total",
     "serve_recompiles_total",
+    # the SLO plane + flight recorder (docs/flight-recorder.md's runbook
+    # and the serve-bench artifact both key off these exact names)
+    "slo_e2e_seconds",
+    "slo_stage_seconds",
+    "slo_budget_burn_ratio",
+    "slo_breaches_total",
+    "flight_journal_records_total",
+    "flight_bundles_total",
 )
 
 _CALL = re.compile(

@@ -530,6 +530,28 @@ def cmd_serve_detect(args) -> int:
         params = init_untrained_params(model, cfg)
 
     service = OnlineDetectionService(params, model, cfg=cfg)
+    recorder = None
+    uninstall_crash = None
+    if args.flight_dir:
+        # incident flight recorder (docs/flight-recorder.md): trailing-p99
+        # breach / drop burst / shadow-disagreement / guardrail-veto
+        # triggers dump self-contained bundles into --flight-dir, and the
+        # excepthook+faulthandler hooks turn an uncaught crash into a
+        # bundle too — wired BEFORE streams connect so startup failures
+        # are already covered
+        from nerrf_tpu.flight import (
+            FlightConfig,
+            FlightRecorder,
+            install_crash_handlers,
+        )
+
+        recorder = FlightRecorder(
+            FlightConfig(out_dir=args.flight_dir,
+                         p99_breach_sec=args.deadline_sec),
+            info=service.flight_info, slo=service.slo, log=_log)
+        service.attach_flight(recorder)
+        uninstall_crash = install_crash_handlers(recorder)
+        _log(f"flight recorder armed: bundles in {args.flight_dir}")
     if manager is not None:
         manager.attach(service)
         manager.start_polling()
@@ -617,6 +639,20 @@ def cmd_serve_detect(args) -> int:
             for reason in ("backpressure", "oversize", "leave", "closed")}
         print(json.dumps(summary, indent=2))
         return 0
+    except BaseException as e:
+        # a MAIN-thread crash would only reach sys.excepthook AFTER the
+        # finally below has already uninstalled it — journal (→ bundle)
+        # here, while the recorder is still subscribed.  Ctrl-C is a
+        # routine shutdown, not an incident: an `exception` bundle per
+        # interactive stop would evict real evidence under max_bundles
+        if recorder is not None and not isinstance(
+                e, (SystemExit, KeyboardInterrupt)):
+            from nerrf_tpu.flight.journal import DEFAULT_JOURNAL
+            from nerrf_tpu.flight.recorder import journal_exception
+
+            journal_exception(DEFAULT_JOURNAL, type(e), e,
+                              e.__traceback__, "main")
+        raise
     finally:
         if manager is not None:
             manager.close()
@@ -625,6 +661,10 @@ def cmd_serve_detect(args) -> int:
             rs.stop()
         if metrics:
             metrics.close()
+        if recorder is not None:
+            recorder.close()
+        if uninstall_crash is not None:
+            uninstall_crash()
 
 
 def cmd_ingest(args) -> int:
@@ -698,8 +738,15 @@ def cmd_ingest(args) -> int:
 
 
 def cmd_doctor(args) -> int:
-    """Environment doctor (scripts/check_env.py as a CLI surface): python
-    deps, bounded backend probe, toolchain, native libs, capture, sandbox."""
+    """Two doctors behind one verb.  With a BUNDLE argument: the incident
+    doctor — reconstruct a flight-recorder bundle's timeline + per-stage
+    attribution offline, no live process needed (docs/flight-recorder.md).
+    Without: the environment doctor (scripts/check_env.py): python deps,
+    bounded backend probe, toolchain, native libs, capture, sandbox."""
+    if args.bundle:
+        from nerrf_tpu.flight.doctor import doctor_main
+
+        return doctor_main(args.bundle, tail=args.tail, as_json=args.json)
     import runpy
     import sys as _sys
 
@@ -901,6 +948,13 @@ def main(argv=None) -> int:
                         "ingest (9091) coexist on one host")
     p.add_argument("--out", default=None, metavar="DIR",
                    help="write per-stream detection JSON + alerts.jsonl")
+    p.add_argument("--flight-dir", default=None, metavar="DIR",
+                   help="arm the incident flight recorder: anomaly "
+                        "triggers (p99 breach, drop burst, shadow "
+                        "disagreement, guardrail veto, uncaught crash via "
+                        "excepthook+faulthandler) dump self-contained "
+                        "diagnostic bundles here, readable offline with "
+                        "`nerrf doctor <bundle>`")
     p.add_argument("--no-probe", action="store_true",
                    help="skip the bounded accelerator-reachability probe")
     p.add_argument("--trace-out", default=None, metavar="FILE",
@@ -928,10 +982,17 @@ def main(argv=None) -> int:
                    help="suppression file (default: .nerrflint-baseline)")
     p.set_defaults(fn=cmd_lint)
 
-    p = sub.add_parser("doctor", help="diagnose the environment (deps, "
-                                      "backend, toolchain, capture, sandbox)")
+    p = sub.add_parser("doctor", help="diagnose the environment, or read a "
+                                      "flight-recorder incident bundle")
+    p.add_argument("bundle", nargs="?", default=None,
+                   help="flight bundle directory (bundle-<utc>-<trigger>): "
+                        "print the incident timeline + per-stage "
+                        "attribution offline; omit for the environment "
+                        "doctor")
+    p.add_argument("--tail", type=int, default=None,
+                   help="only the last N journal records of the timeline")
     p.add_argument("--build", action="store_true",
-                   help="also build missing native libraries")
+                   help="also build missing native libraries (env mode)")
     p.add_argument("--json", action="store_true",
                    help="machine-readable summary")
     p.set_defaults(fn=cmd_doctor)

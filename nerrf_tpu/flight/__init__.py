@@ -1,0 +1,52 @@
+"""Incident flight recorder + end-to-end SLO plane for the serve path.
+
+Three cooperating pieces, all stdlib-only (like the metrics registry and
+the tracing spine they ride on):
+
+  * `journal` — a bounded, thread-safe ring of typed `JournalRecord`s
+    (batch closes, admission/demux drops, registry lifecycle verdicts,
+    readiness flips, config/model fingerprints), each stamped with a
+    monotonic sequence number and the window/trace IDs it touched.  The
+    structured companion to the span ring: spans say *where time went*,
+    the journal says *what the system decided*.
+  * `slo` — end-to-end SLO accounting: every window carries its event time
+    through admit → pack → device → demux, producing per-stream
+    ``nerrf_slo_e2e_seconds`` histograms, per-stage budget-burn gauges and
+    exemplar trace IDs (the slowest recent window per stream) so a slow
+    alert links back to its exact batch's span tree.
+  * `recorder` — declarative anomaly triggers (trailing-p99 breach, drop
+    burst, shadow-disagreement spike, guardrail veto, uncaught exception)
+    that atomically dump a self-contained diagnostic bundle: journal tail,
+    Chrome-trace export, metrics snapshot, model lineage, environment
+    fingerprint.  Rate-limited per trigger and bounded on disk; readable
+    offline by ``nerrf doctor <bundle>`` (`doctor.py`).
+
+docs/flight-recorder.md is the operator guide.
+"""
+
+from nerrf_tpu.flight.journal import (
+    DEFAULT_JOURNAL,
+    EventJournal,
+    JournalRecord,
+    fingerprint,
+    make_trace_id,
+)
+from nerrf_tpu.flight.recorder import (
+    FlightConfig,
+    FlightRecorder,
+    install_crash_handlers,
+)
+from nerrf_tpu.flight.slo import SLO_BUCKETS, SLOTracker
+
+__all__ = [
+    "DEFAULT_JOURNAL",
+    "EventJournal",
+    "JournalRecord",
+    "FlightConfig",
+    "FlightRecorder",
+    "SLOTracker",
+    "SLO_BUCKETS",
+    "fingerprint",
+    "install_crash_handlers",
+    "make_trace_id",
+]

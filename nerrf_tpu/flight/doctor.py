@@ -1,0 +1,188 @@
+"""Offline bundle reader: `nerrf doctor <bundle>`.
+
+Reconstructs an incident from a flight-recorder bundle alone — no live
+process, no scrape history.  The report has four sections:
+
+  1. header — trigger, reason, when, environment + model lineage at dump;
+  2. incident timeline — the journal tail, one line per record, timed
+     relative to the bundle's creation (negative = before the trigger);
+  3. per-stage attribution — `nerrf trace`'s latency table over the
+     bundled span ring (the same Chrome-trace file loads in Perfetto);
+  4. SLO state — per-stream trailing p50/p99/breaches and budget burn
+     from the manifest's SLO snapshot, exemplar trace IDs included.
+
+Unreadable pieces degrade per-section (a bundle written mid-crash may
+lack a file) — partial evidence beats no report.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import List, Optional
+
+from nerrf_tpu.flight.journal import JournalRecord, load_journal
+
+REQUIRED_FILES = ("manifest.json", "journal.jsonl", "trace.json",
+                  "metrics.prom")
+
+
+def read_bundle(path) -> dict:
+    """Load a bundle directory → {"manifest", "records", "events",
+    "metrics", "missing"}.  Raises FileNotFoundError only when ``path``
+    is not a bundle at all (no manifest)."""
+    root = os.fspath(path)
+    manifest_path = os.path.join(root, "manifest.json")
+    if not os.path.isfile(manifest_path):
+        raise FileNotFoundError(
+            f"{root} is not a flight bundle (no manifest.json)")
+    with open(manifest_path) as f:
+        manifest = json.load(f)
+    out = {"manifest": manifest, "records": [], "events": [],
+           "metrics": "", "missing": []}
+    jpath = os.path.join(root, "journal.jsonl")
+    if os.path.isfile(jpath):
+        out["records"] = load_journal(jpath)
+    else:
+        out["missing"].append("journal.jsonl")
+    tpath = os.path.join(root, "trace.json")
+    if os.path.isfile(tpath):
+        try:
+            from nerrf_tpu.tracing import load_chrome_trace
+
+            out["events"] = load_chrome_trace(tpath)
+        except (OSError, ValueError):
+            out["missing"].append("trace.json")
+    else:
+        out["missing"].append("trace.json")
+    mpath = os.path.join(root, "metrics.prom")
+    if os.path.isfile(mpath):
+        with open(mpath) as f:
+            out["metrics"] = f.read()
+    else:
+        out["missing"].append("metrics.prom")
+    return out
+
+
+def _fmt_record(rec: JournalRecord, t0_wall: float) -> str:
+    dt = rec.t_wall - t0_wall
+    who = rec.stream or "-"
+    if rec.window_id is not None:
+        who += f"/w{rec.window_id}"
+    extras = " ".join(
+        f"{k}={_compact(v)}" for k, v in sorted(rec.data.items()))
+    tid = f" [{rec.trace_id}]" if rec.trace_id else ""
+    return (f"  #{rec.seq:<6} {dt:+9.3f}s  {rec.kind:<18} "
+            f"{who:<16}{tid} {extras}").rstrip()
+
+
+def _compact(v) -> str:
+    if isinstance(v, float):
+        return f"{v:g}"
+    if isinstance(v, (list, tuple)):
+        return ",".join(str(x) for x in v[:6]) + ("…" if len(v) > 6 else "")
+    if isinstance(v, dict):
+        return "{" + ",".join(f"{k}:{_compact(x)}"
+                              for k, x in sorted(v.items())) + "}"
+    s = str(v)
+    return s if len(s) <= 60 else s[:57] + "…"
+
+
+def format_report(bundle: dict, tail: Optional[int] = None) -> str:
+    man = bundle["manifest"]
+    lines: List[str] = []
+    lines.append(f"flight bundle: trigger={man.get('trigger')} "
+                 f"at {man.get('created_utc')}")
+    lines.append(f"  reason: {man.get('reason')}")
+    ctx = man.get("context") or {}
+    if ctx:
+        lines.append("  context: " + " ".join(
+            f"{k}={_compact(v)}" for k, v in sorted(ctx.items())))
+    env = man.get("env") or {}
+    if env:
+        lines.append(
+            "  env: python %s, %s, backend=%s, pid=%s"
+            % (env.get("python"), env.get("platform"),
+               env.get("jax_backend", "n/a"), env.get("pid")))
+    lineage = man.get("lineage")
+    if lineage:
+        lines.append("  model: " + " ".join(
+            f"{k}={_compact(v)}" for k, v in sorted(lineage.items())))
+    if bundle["missing"]:
+        lines.append("  MISSING from bundle: "
+                     + ", ".join(bundle["missing"]))
+
+    records = bundle["records"]
+    if tail is not None:
+        records = records[-tail:]
+    lines.append("")
+    seq = man.get("journal_seq") or {}
+    lines.append(f"incident timeline ({len(records)} records, "
+                 f"seq {seq.get('lo')}..{seq.get('hi')}; "
+                 f"t relative to the trigger):")
+    t0 = float(man.get("created_unix") or
+               (records[-1].t_wall if records else 0.0))
+    for rec in records:
+        lines.append(_fmt_record(rec, t0))
+    if not records:
+        lines.append("  (no journal records)")
+
+    lines.append("")
+    if bundle["events"]:
+        from nerrf_tpu.tracing import format_stage_table
+
+        lines.append("per-stage attribution (bundled span ring):")
+        lines.append(format_stage_table(bundle["events"]))
+    else:
+        lines.append("per-stage attribution: no span events in bundle")
+
+    slo = man.get("slo") or {}
+    per_stream = slo.get("per_stream") or {}
+    lines.append("")
+    if per_stream:
+        lines.append(f"SLO state (deadline {slo.get('deadline_sec')}s, "
+                     f"trailing exact percentiles):")
+        header = (f"  {'stream':<18} {'n':>6} {'p50_ms':>9} {'p99_ms':>9} "
+                  f"{'breaches':>8}  worst")
+        lines.append(header)
+        for stream, s in sorted(per_stream.items()):
+            worst = s.get("exemplar_trace_id") or "-"
+            lines.append(
+                f"  {stream:<18} {s.get('count', 0):>6} "
+                f"{_num(s.get('p50_ms')):>9} {_num(s.get('p99_ms')):>9} "
+                f"{s.get('breaches', 0):>8}  {worst} "
+                f"({_num(s.get('exemplar_ms'))}ms)")
+            burn = s.get("budget_burn") or {}
+            if burn:
+                lines.append("  " + " " * 18 + "burn: " + " ".join(
+                    f"{k}={v:.0%}" for k, v in sorted(burn.items())))
+    else:
+        lines.append("SLO state: not recorded in manifest")
+    return "\n".join(lines)
+
+
+def _num(v) -> str:
+    return "-" if v is None else f"{v:g}"
+
+
+def doctor_main(path, tail: Optional[int] = None, as_json: bool = False,
+                out=print) -> int:
+    """The `nerrf doctor <bundle>` body; returns a CLI exit code."""
+    try:
+        bundle = read_bundle(path)
+    except FileNotFoundError as e:
+        out(str(e))
+        return 2
+    except (OSError, ValueError) as e:
+        out(f"cannot read bundle {path}: {e}")
+        return 2
+    if as_json:
+        out(json.dumps({
+            "manifest": bundle["manifest"],
+            "records": [r.to_dict() for r in bundle["records"]],
+            "span_events": len(bundle["events"]),
+            "missing": bundle["missing"],
+        }, indent=2))
+    else:
+        out(format_report(bundle, tail=tail))
+    return 1 if bundle["missing"] else 0

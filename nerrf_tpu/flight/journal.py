@@ -1,0 +1,205 @@
+"""Structured event journal: the serve path's bounded decision log.
+
+`EventJournal` is a thread-safe ring of typed `JournalRecord`s — the
+"what happened" companion to the tracing spine's "where did the time go"
+span ring.  Every record carries a process-monotonic sequence number (so
+the tail is orderable even across clock steps), wall-clock and
+perf-counter timestamps (the perf stamp aligns with span ``ts`` values for
+offline joins), a record ``kind``, and the stream/window/trace IDs it
+touched.
+
+Record kinds in use (producers in parentheses):
+
+    batch_close       a bucket's shared batch assembled (serve/batcher)
+    batch_failed      a device batch's scoring raised (serve/batcher)
+    admission_drop    window dropped at admission, with reason (serve/service)
+    demux_drop        alert evicted from the full sink (serve/alerts)
+    readiness         admission opened/closed (serve/service)
+    config            serve config fingerprint at start (serve/service)
+    slo_breach        a window blew its e2e deadline (flight/slo)
+    registry_publish  a checkpoint became an immutable version (registry/store)
+    registry_shadow   candidate staged for shadow scoring (registry/manager)
+    registry_promote  candidate promoted to LIVE (registry/manager)
+    registry_veto     guardrail vetoed a candidate (registry/manager)
+    registry_swap     live params hot-swapped, incl. rollbacks (registry/manager)
+    train_start/done  training-run config+model fingerprints (train/loop)
+    exception         uncaught exception captured by the crash hook
+    bundle            a flight-recorder bundle was written (flight/recorder)
+
+The ring records unconditionally: appends are a lock + deque append +
+counter increment (~µs), bounded memory by construction.  Listeners (the
+flight recorder's trigger engine) are invoked OUTSIDE the journal lock so
+a slow listener can never block producers against each other.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional
+
+
+def make_trace_id(stream: str, window_idx: int, lo_ns: int) -> str:
+    """Deterministic per-window trace ID: the same (stream, window, epoch)
+    always maps to the same ID, so journal records, spans, alerts and
+    offline reprocessing join on it without coordination."""
+    h = hashlib.blake2s(f"{stream}:{window_idx}:{lo_ns}".encode(),
+                        digest_size=6).hexdigest()
+    return f"w-{h}"
+
+
+def fingerprint(obj) -> str:
+    """Short stable fingerprint of a config/params-identity object (repr
+    based — for dataclass configs repr is canonical and total)."""
+    return hashlib.blake2s(repr(obj).encode(), digest_size=6).hexdigest()
+
+
+@dataclasses.dataclass
+class JournalRecord:
+    """One journal entry.  ``data`` is the kind-specific payload (bucket,
+    occupancy, reason, version, …) — JSON-serializable by contract."""
+
+    seq: int
+    t_wall: float           # unix seconds (human timeline)
+    t_perf: float           # perf-counter seconds (joins with span ts)
+    kind: str
+    stream: Optional[str] = None
+    window_id: Optional[int] = None
+    trace_id: Optional[str] = None
+    data: Dict = dataclasses.field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        d = {"seq": self.seq, "t_wall": self.t_wall, "t_perf": self.t_perf,
+             "kind": self.kind}
+        if self.stream is not None:
+            d["stream"] = self.stream
+        if self.window_id is not None:
+            d["window_id"] = self.window_id
+        if self.trace_id is not None:
+            d["trace_id"] = self.trace_id
+        if self.data:
+            d["data"] = self.data
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "JournalRecord":
+        return cls(seq=int(d["seq"]), t_wall=float(d["t_wall"]),
+                   t_perf=float(d.get("t_perf", 0.0)), kind=str(d["kind"]),
+                   stream=d.get("stream"), window_id=d.get("window_id"),
+                   trace_id=d.get("trace_id"), data=dict(d.get("data") or {}))
+
+
+class EventJournal:
+    """Bounded, thread-safe, listener-fanning record ring."""
+
+    def __init__(self, capacity: int = 4096, registry=None) -> None:
+        self._lock = threading.Lock()
+        self._records: deque = deque(maxlen=max(capacity, 1))
+        self._seq = 0
+        self._registry = registry
+        self._listeners: List[Callable[[JournalRecord], None]] = []
+
+    def _reg(self):
+        if self._registry is None:
+            from nerrf_tpu.observability import DEFAULT_REGISTRY
+
+            self._registry = DEFAULT_REGISTRY
+        return self._registry
+
+    # -- producing -----------------------------------------------------------
+
+    def record(self, kind: str, stream: Optional[str] = None,
+               window_id: Optional[int] = None,
+               trace_id: Optional[str] = None, **data) -> JournalRecord:
+        with self._lock:
+            self._seq += 1
+            rec = JournalRecord(
+                seq=self._seq, t_wall=time.time(),
+                t_perf=time.perf_counter(), kind=kind, stream=stream,
+                window_id=window_id, trace_id=trace_id, data=data)
+            self._records.append(rec)
+            listeners = list(self._listeners)
+        self._reg().counter_inc(
+            "flight_journal_records_total", labels={"kind": kind},
+            help="structured journal records appended, by record kind")
+        # listeners run OUTSIDE the lock: a trigger evaluating (or a bundle
+        # dumping) must never serialize unrelated producers
+        for fn in listeners:
+            try:
+                fn(rec)
+            except Exception:  # noqa: BLE001 — observers are advisory
+                pass
+        return rec
+
+    def subscribe(self, fn: Callable[[JournalRecord], None]) -> None:
+        with self._lock:
+            self._listeners.append(fn)
+
+    def unsubscribe(self, fn: Callable[[JournalRecord], None]) -> None:
+        with self._lock:
+            if fn in self._listeners:
+                self._listeners.remove(fn)
+
+    # -- reading -------------------------------------------------------------
+
+    @property
+    def seq(self) -> int:
+        with self._lock:
+            return self._seq
+
+    def tail(self, n: Optional[int] = None,
+             kinds: Optional[tuple] = None,
+             since_seq: Optional[int] = None) -> List[JournalRecord]:
+        """Newest-last slice of the ring: at most ``n`` records, optionally
+        filtered by kind and/or a minimum (exclusive) sequence number."""
+        with self._lock:
+            recs = list(self._records)
+        if kinds is not None:
+            recs = [r for r in recs if r.kind in kinds]
+        if since_seq is not None:
+            recs = [r for r in recs if r.seq > since_seq]
+        return recs[-n:] if n is not None else recs
+
+    def clear(self) -> None:
+        with self._lock:
+            self._records.clear()
+
+    def to_jsonl(self, n: Optional[int] = None) -> str:
+        return "".join(json.dumps(r.to_dict()) + "\n" for r in self.tail(n))
+
+    def write(self, path, n: Optional[int] = None) -> str:
+        path = os.fspath(path)
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(path, "w") as f:
+            f.write(self.to_jsonl(n))
+        return path
+
+
+def load_journal(path) -> List[JournalRecord]:
+    """Parse a journal.jsonl back into records (the doctor's reader).
+    Malformed lines are skipped, not fatal — a bundle written mid-crash is
+    still evidence."""
+    out: List[JournalRecord] = []
+    with open(os.fspath(path)) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                out.append(JournalRecord.from_dict(json.loads(line)))
+            except (ValueError, KeyError, TypeError):
+                continue
+    return out
+
+
+# The process-wide journal every pipeline component records into (the
+# decision-log analogue of observability.DEFAULT_REGISTRY and
+# tracing.DEFAULT_TRACER).
+DEFAULT_JOURNAL = EventJournal()

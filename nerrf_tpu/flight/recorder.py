@@ -1,0 +1,409 @@
+"""Anomaly-triggered flight recorder: dump the evidence while it exists.
+
+The journal ring and the span ring both wrap — by the time an operator
+logs in, the 2am incident's records are gone.  `FlightRecorder` watches
+the live signals and, the moment a declarative trigger fires, atomically
+writes a self-contained **bundle** directory:
+
+    bundle-<utc>-<trigger>/
+      manifest.json   trigger, reason, context, SLO snapshot, model
+                      lineage, environment fingerprint, journal seq range
+      journal.jsonl   the journal tail (newest last)
+      trace.json      Chrome-trace export of the span ring (Perfetto/
+                      chrome://tracing loadable, `nerrf trace` readable)
+      metrics.prom    full Prometheus text-exposition snapshot
+
+Triggers (all evaluated in-process, no scrape loop):
+
+  * ``p99_breach``   — trailing-window p99 of e2e window latency crosses
+    the threshold (default: the window deadline), min-count gated;
+  * ``drop_burst``   — ≥ N ``admission_drop``/``demux_drop`` journal
+    records within a sliding T seconds;
+  * ``shadow_disagreement`` — a ``registry_shadow_stats`` journal record
+    reports a disagreement rate above the spike threshold;
+  * ``guardrail_veto``     — any ``registry_veto`` journal record;
+  * ``exception``    — uncaught exception on any thread, via the
+    `install_crash_handlers` sys/threading excepthook wrappers
+    (+ `faulthandler` into the bundle directory for hard crashes).
+
+Every trigger is rate-limited (one bundle per ``min_interval_sec`` per
+trigger) and the bundle directory is bounded (oldest deleted beyond
+``max_bundles``) — an alert storm can never fill the disk.  Bundles are
+written to a temp dir and `os.replace`d into place, so a reader never
+sees a torn bundle.  `nerrf doctor <bundle>` reconstructs the incident
+timeline offline (`flight.doctor`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import faulthandler
+import json
+import os
+import platform
+import shutil
+import sys
+import threading
+import time
+import traceback
+from collections import deque
+from typing import Dict, Optional
+
+from nerrf_tpu.flight.journal import DEFAULT_JOURNAL, JournalRecord
+from nerrf_tpu.flight.slo import percentile
+
+DROP_KINDS = ("admission_drop", "demux_drop")
+
+
+@dataclasses.dataclass(frozen=True)
+class FlightConfig:
+    """Trigger thresholds + bundle retention knobs."""
+
+    out_dir: str = "flight-bundles"
+    # rate limit: at most one bundle per trigger name per interval —
+    # a sustained breach produces ONE bundle, not a bundle storm
+    min_interval_sec: float = 60.0
+    # disk bound: oldest bundle-* dirs deleted beyond this many
+    max_bundles: int = 8
+    # journal records embedded per bundle (newest last)
+    journal_tail: int = 512
+    # p99_breach: trailing window of e2e latencies, min sample gate, and
+    # the breach threshold (None → the serve deadline passed at wiring)
+    p99_window: int = 64
+    p99_min_count: int = 16
+    p99_breach_sec: Optional[float] = None
+    # drop_burst: this many drop records inside the sliding window
+    drop_burst_n: int = 10
+    drop_burst_sec: float = 5.0
+    # shadow_disagreement: spike threshold on the reported rate, gated on
+    # a minimum paired-window count — the first shadow-scored window's
+    # rate is single-batch noise, not an incident
+    disagreement_spike: float = 0.35
+    disagreement_min_windows: int = 8
+
+
+class FlightRecorder:
+    """Watches journal records + per-window latencies; dumps bundles."""
+
+    def __init__(self, cfg: FlightConfig, registry=None, journal=None,
+                 tracer=None, slo=None, info=None, log=None) -> None:
+        if registry is None:
+            from nerrf_tpu.observability import DEFAULT_REGISTRY
+
+            registry = DEFAULT_REGISTRY
+        if tracer is None:
+            from nerrf_tpu.tracing import DEFAULT_TRACER
+
+            tracer = DEFAULT_TRACER
+        self.cfg = cfg
+        self._reg = registry
+        self._journal = journal if journal is not None else DEFAULT_JOURNAL
+        self._tracer = tracer
+        self._slo = slo
+        # info(): live model lineage / service identity for the manifest —
+        # callable so the bundle captures the state AT dump time
+        self._info = info or (lambda: {})
+        self._log = log or (lambda msg: None)
+        self._lock = threading.Lock()
+        # dumps are serialized: concurrent triggers writing + the .tmp
+        # sweep in retention must never see each other's half-written dirs
+        self._dump_lock = threading.Lock()
+        self._last_fire: Dict[str, float] = {}
+        self._bundle_n = 0  # monotonic: bundle names sort chronologically
+        self._e2e: deque = deque(maxlen=max(cfg.p99_window, 1))
+        self._drops: deque = deque()
+        self._journal.subscribe(self._on_record)
+
+    def close(self) -> None:
+        self._journal.unsubscribe(self._on_record)
+
+    # -- signal intake --------------------------------------------------------
+
+    def observe_window(self, stream: str, trace_id: Optional[str],
+                       e2e_sec: float) -> None:
+        """Per-scored-window latency feed (the p99_breach trigger's
+        signal).  Cheap: deque append + an occasional sorted() of a small
+        trailing window."""
+        threshold = self.cfg.p99_breach_sec
+        if threshold is None:
+            return
+        with self._lock:
+            self._e2e.append((float(e2e_sec), stream, trace_id))
+            if len(self._e2e) < self.cfg.p99_min_count:
+                return
+            vals = sorted(e for e, _, _ in self._e2e)
+            p99 = percentile(vals, 0.99)
+            # worst of the TRAILING window (the breaching set), so the
+            # bundle's exemplar trace ID always joins to evidence still in
+            # the span/journal rings — never an ancient evicted spike
+            worst = max(self._e2e, key=lambda t: t[0])
+        if p99 > threshold:
+            self.trigger(
+                "p99_breach",
+                f"trailing p99 {p99 * 1e3:.1f}ms > "
+                f"{threshold * 1e3:.1f}ms over last {len(vals)} windows",
+                context={"p99_ms": round(p99 * 1e3, 1),
+                         "threshold_ms": round(threshold * 1e3, 1),
+                         "windows": len(vals),
+                         "worst_ms": round(worst[0] * 1e3, 1),
+                         "stream": worst[1], "trace_id": worst[2]})
+
+    def _on_record(self, rec: JournalRecord) -> None:
+        """Journal listener: the declarative record-kind triggers."""
+        if rec.kind == "bundle":
+            return  # our own breadcrumb — never self-trigger
+        if rec.kind in DROP_KINDS:
+            now = rec.t_perf
+            with self._lock:
+                self._drops.append(now)
+                lo = now - self.cfg.drop_burst_sec
+                while self._drops and self._drops[0] < lo:
+                    self._drops.popleft()
+                burst = len(self._drops)
+            if burst >= self.cfg.drop_burst_n:
+                self.trigger(
+                    "drop_burst",
+                    f"{burst} windows dropped in the last "
+                    f"{self.cfg.drop_burst_sec:g}s "
+                    f"(latest: {rec.data.get('reason', rec.kind)})",
+                    context={"drops": burst,
+                             "window_sec": self.cfg.drop_burst_sec,
+                             "stream": rec.stream,
+                             "trace_id": rec.trace_id})
+        elif rec.kind == "registry_veto":
+            self.trigger(
+                "guardrail_veto",
+                f"shadow v{rec.data.get('version')} vetoed: "
+                f"{rec.data.get('reason', 'unknown')}",
+                context=dict(rec.data))
+        elif rec.kind == "registry_shadow_stats":
+            rate = float(rec.data.get("disagreement_rate", 0.0))
+            windows = int(rec.data.get("windows", 0))
+            if (rate >= self.cfg.disagreement_spike
+                    and windows >= self.cfg.disagreement_min_windows):
+                self.trigger(
+                    "shadow_disagreement",
+                    f"shadow disagreement rate {rate:.3f} >= "
+                    f"{self.cfg.disagreement_spike:g}",
+                    context=dict(rec.data))
+        elif rec.kind == "exception":
+            self.trigger(
+                "exception",
+                f"{rec.data.get('type')}: {rec.data.get('message')}",
+                context=dict(rec.data, stream=rec.stream))
+
+    # -- firing ---------------------------------------------------------------
+
+    def trigger(self, name: str, reason: str,
+                context: Optional[dict] = None) -> Optional[str]:
+        """Fire a trigger: rate-limit, then dump.  Returns the bundle path
+        (None when suppressed or the dump failed — the recorder must never
+        take the serving plane down with it)."""
+        now = time.monotonic()
+        with self._lock:
+            last = self._last_fire.get(name)
+            if last is not None and now - last < self.cfg.min_interval_sec:
+                suppressed = True
+            else:
+                self._last_fire[name] = now
+                suppressed = False
+        if suppressed:
+            self._reg.counter_inc(
+                "flight_triggers_suppressed_total", labels={"trigger": name},
+                help="trigger firings suppressed by the per-trigger rate "
+                     "limit (a bundle for this incident already exists)")
+            return None
+        try:
+            path = self.dump(name, reason, context or {})
+        except Exception as e:  # noqa: BLE001 — evidence capture is
+            # best-effort; a full disk must not crash the scorer thread
+            with self._lock:
+                # a failed dump must not consume the interval: with zero
+                # bundles on disk the next firing should retry, not be
+                # suppressed for min_interval_sec while the rings wrap
+                # (unless a concurrent fire already succeeded after us)
+                if self._last_fire.get(name) == now:
+                    if last is None:
+                        self._last_fire.pop(name, None)
+                    else:
+                        self._last_fire[name] = last
+            self._log(f"flight: bundle dump failed ({type(e).__name__}: {e})")
+            return None
+        self._log(f"flight: {name} → {path} ({reason})")
+        return path
+
+    def dump(self, trigger: str, reason: str, context: dict) -> str:
+        """Atomically write one bundle and enforce the disk bound."""
+        with self._dump_lock:
+            return self._dump_locked(trigger, reason, context)
+
+    def _dump_locked(self, trigger: str, reason: str, context: dict) -> str:
+        out_root = os.fspath(self.cfg.out_dir)
+        os.makedirs(out_root, exist_ok=True)
+        stamp = time.strftime("%Y%m%dT%H%M%S", time.gmtime())
+        with self._lock:
+            self._bundle_n += 1
+            n = self._bundle_n
+        # the in-process counter keeps same-second names distinct AND
+        # lexicographically chronological — retention sorts by name, so
+        # "oldest" must never be a naming accident
+        name = f"bundle-{stamp}-{n:03d}-{trigger}"
+        final = os.path.join(out_root, name)
+        tmp = final + ".tmp"
+        if os.path.exists(tmp):
+            shutil.rmtree(tmp)
+        try:
+            os.makedirs(tmp)
+            records = self._journal.tail(self.cfg.journal_tail)
+            with open(os.path.join(tmp, "journal.jsonl"), "w") as f:
+                for r in records:
+                    f.write(json.dumps(r.to_dict()) + "\n")
+            with open(os.path.join(tmp, "trace.json"), "w") as f:
+                json.dump(self._tracer.chrome_trace(), f)
+            with open(os.path.join(tmp, "metrics.prom"), "w") as f:
+                f.write(self._reg.render())
+            manifest = {
+                "schema": 1,
+                "trigger": trigger,
+                "reason": reason,
+                "context": context,
+                "created_unix": time.time(),
+                "created_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                             time.gmtime()),
+                "journal_seq": {"lo": records[0].seq if records else None,
+                                "hi": records[-1].seq if records else None,
+                                "records": len(records)},
+                "slo": self._slo.snapshot() if self._slo is not None
+                       else None,
+                "lineage": _safe(self._info),
+                "env": env_fingerprint(),
+            }
+            with open(os.path.join(tmp, "manifest.json"), "w") as f:
+                json.dump(manifest, f, indent=2)
+            os.replace(tmp, final)  # readers never see a torn bundle
+        except BaseException:
+            # a failed dump (ENOSPC mid-write) must not strand its partial
+            # .tmp — each dump mints a fresh name, so an orphan would
+            # evade retention forever and erode the disk bound
+            shutil.rmtree(tmp, ignore_errors=True)
+            raise
+        self._reg.counter_inc(
+            "flight_bundles_total", labels={"trigger": trigger},
+            help="flight-recorder diagnostic bundles written, by trigger")
+        self._reg.gauge_set(
+            "flight_last_bundle_unix_seconds", manifest["created_unix"],
+            help="when the most recent flight bundle was written")
+        self._journal.record("bundle", trigger=trigger, path=final,
+                             reason=reason)
+        self._enforce_retention(out_root)
+        return final
+
+    def _enforce_retention(self, out_root: str) -> None:
+        entries = [e for e in os.listdir(out_root) if e.startswith("bundle-")]
+        # sweep stale .tmp dirs from a crash mid-dump in an EARLIER process
+        # (a failed dump in this one already cleaned up after itself)
+        for tmp in entries:
+            if tmp.endswith(".tmp") and not os.path.exists(
+                    os.path.join(out_root, tmp[:-4])):
+                shutil.rmtree(os.path.join(out_root, tmp),
+                              ignore_errors=True)
+        bundles = sorted(e for e in entries if not e.endswith(".tmp"))
+        for stale in bundles[:-self.cfg.max_bundles] \
+                if len(bundles) > self.cfg.max_bundles else []:
+            shutil.rmtree(os.path.join(out_root, stale), ignore_errors=True)
+
+
+def _safe(fn) -> Optional[dict]:
+    try:
+        return fn() or None
+    except Exception:  # noqa: BLE001 — manifest extras are best-effort
+        return None
+
+
+def env_fingerprint() -> dict:
+    """Process identity for the manifest: enough to answer "what exactly
+    was running" without the pod.  jax/flax versions only when already
+    imported — the recorder must never force backend init."""
+    out = {
+        "python": sys.version.split()[0],
+        "platform": platform.platform(),
+        "hostname": platform.node(),
+        "pid": os.getpid(),
+        "argv": sys.argv,
+    }
+    for mod in ("jax", "jaxlib", "flax", "numpy"):
+        m = sys.modules.get(mod)
+        v = getattr(m, "__version__", None) if m is not None else None
+        if v is not None:
+            out[f"{mod}_version"] = v
+    jax = sys.modules.get("jax")
+    if jax is not None:
+        try:
+            out["jax_backend"] = jax.default_backend()
+        except Exception:  # noqa: BLE001 — backend may be mid-init
+            pass
+    return out
+
+
+def journal_exception(journal, exc_type, exc, tb,
+                      thread_name: str = "main") -> None:
+    """Journal one uncaught exception (the subscribed recorder's listener
+    turns the record into an ``exception`` bundle).  Shared by the
+    installed hooks AND callers whose own try/finally would otherwise
+    uninstall the hooks before the exception ever reaches them (the serve
+    CLI's main-thread path)."""
+    journal.record(
+        "exception", stream=thread_name,
+        type=getattr(exc_type, "__name__", str(exc_type)),
+        message=str(exc),
+        traceback="".join(
+            traceback.format_exception(exc_type, exc, tb))[-4000:])
+
+
+def install_crash_handlers(recorder: FlightRecorder,
+                           journal=None):
+    """Wire ``sys.excepthook`` + ``threading.excepthook`` to journal the
+    exception and dump an ``exception`` bundle before the previous hooks
+    run, and enable `faulthandler` into ``<out_dir>/faulthandler.log``
+    (hard crashes — SIGSEGV in a native lib — leave tracebacks next to the
+    bundles).  Returns an ``uninstall()`` callable (tests).  The journal
+    defaults to the RECORDER'S journal — the only one whose listeners
+    include this recorder; an embedder wiring an isolated journal would
+    otherwise get crash records it is not subscribed to (and no bundle)."""
+    journal = journal if journal is not None else recorder._journal
+    os.makedirs(recorder.cfg.out_dir, exist_ok=True)
+    fh_file = open(  # noqa: SIM115 — must outlive this frame
+        os.path.join(recorder.cfg.out_dir, "faulthandler.log"), "a")
+    faulthandler.enable(file=fh_file)
+
+    def capture(exc_type, exc, tb, thread_name: str) -> None:
+        journal_exception(journal, exc_type, exc, tb, thread_name)
+        # the journal listener fires the `exception` trigger; nothing more
+        # to do here — capture must stay exception-free itself
+
+    prev_sys = sys.excepthook
+    prev_threading = threading.excepthook
+
+    def sys_hook(exc_type, exc, tb):
+        try:
+            capture(exc_type, exc, tb, "main")
+        finally:
+            prev_sys(exc_type, exc, tb)
+
+    def threading_hook(args):
+        try:
+            capture(args.exc_type, args.exc_value, args.exc_traceback,
+                    getattr(args.thread, "name", "thread"))
+        finally:
+            prev_threading(args)
+
+    sys.excepthook = sys_hook
+    threading.excepthook = threading_hook
+
+    def uninstall() -> None:
+        sys.excepthook = prev_sys
+        threading.excepthook = prev_threading
+        faulthandler.disable()
+        fh_file.close()
+
+    return uninstall

@@ -151,38 +151,72 @@ class MetricsRegistry:
                     "expected 'sum', 'count' or 'mean'")
         return 0.0
 
-    def render(self) -> str:
-        """Prometheus text exposition format, one block per metric."""
-        out = []
+    def remove_series(self, name: str,
+                      labels: Optional[Dict[str, str]] = None) -> bool:
+        """Drop ONE labeled series of a metric (the metric itself, its
+        type and its other series stay).  For per-entity series — e.g. the
+        SLO plane's per-stream histograms — whose entity set is unbounded
+        over a pod's lifetime: retiring a departed entity's series bounds
+        label cardinality in memory and in the scrape.  Returns whether
+        anything was removed."""
+        k = _labelkey(labels)
+        removed = False
         with self._lock:
-            for name, series in sorted(self._counters.items()):
-                full = self._name(name)
-                if name in self._help:
-                    out.append(f"# HELP {full} {_escape_help(self._help[name])}")
-                out.append(f"# TYPE {full} counter")
-                for k, v in sorted(series.items()):
-                    out.append(f"{full}{_fmt_labels(k)} {v:g}")
-            for name, series in sorted(self._gauges.items()):
-                full = self._name(name)
-                if name in self._help:
-                    out.append(f"# HELP {full} {_escape_help(self._help[name])}")
-                out.append(f"# TYPE {full} gauge")
-                for k, v in sorted(series.items()):
-                    out.append(f"{full}{_fmt_labels(k)} {v:g}")
-            for name, series in sorted(self._hists.items()):
-                full = self._name(name)
-                bk = self._hist_buckets[name]
-                if name in self._help:
-                    out.append(f"# HELP {full} {_escape_help(self._help[name])}")
-                out.append(f"# TYPE {full} histogram")
-                for k, cell in sorted(series.items()):
-                    for i, b in enumerate(bk):
-                        lk = _labelkey(dict(dict(k), le=f"{b:g}"))
-                        out.append(f"{full}_bucket{_fmt_labels(lk)} {cell[i]}")
-                    lk = _labelkey(dict(dict(k), le="+Inf"))
-                    out.append(f"{full}_bucket{_fmt_labels(lk)} {cell[len(bk)]}")
-                    out.append(f"{full}_sum{_fmt_labels(k)} {cell[-2]:g}")
-                    out.append(f"{full}_count{_fmt_labels(k)} {cell[-1]}")
+            for table in (self._counters, self._gauges, self._hists):
+                d = table.get(name)
+                if d is not None and k in d:
+                    del d[k]
+                    removed = True
+        return removed
+
+    def render(self) -> str:
+        """Prometheus text exposition format, one block per metric.
+
+        Two-phase by design: SNAPSHOT the registry state under the lock
+        (cheap copies — histogram cells are list-copied so a concurrent
+        ``histogram_observe`` can never interleave its multi-field update
+        mid-scrape and expose a cell whose bucket counts disagree with its
+        ``_count``), then FORMAT outside the lock — string assembly is the
+        expensive part of a scrape and must not stall the scoring plane's
+        writers for its duration."""
+        with self._lock:
+            counters = {n: sorted(s.items())
+                        for n, s in sorted(self._counters.items())}
+            gauges = {n: sorted(s.items())
+                      for n, s in sorted(self._gauges.items())}
+            hists = {n: sorted((k, list(cell)) for k, cell in s.items())
+                     for n, s in sorted(self._hists.items())}
+            hist_buckets = dict(self._hist_buckets)
+            help_text = dict(self._help)
+        out = []
+        for name, series in counters.items():
+            full = self._name(name)
+            if name in help_text:
+                out.append(f"# HELP {full} {_escape_help(help_text[name])}")
+            out.append(f"# TYPE {full} counter")
+            for k, v in series:
+                out.append(f"{full}{_fmt_labels(k)} {v:g}")
+        for name, series in gauges.items():
+            full = self._name(name)
+            if name in help_text:
+                out.append(f"# HELP {full} {_escape_help(help_text[name])}")
+            out.append(f"# TYPE {full} gauge")
+            for k, v in series:
+                out.append(f"{full}{_fmt_labels(k)} {v:g}")
+        for name, series in hists.items():
+            full = self._name(name)
+            bk = hist_buckets[name]
+            if name in help_text:
+                out.append(f"# HELP {full} {_escape_help(help_text[name])}")
+            out.append(f"# TYPE {full} histogram")
+            for k, cell in series:
+                for i, b in enumerate(bk):
+                    lk = _labelkey(dict(dict(k), le=f"{b:g}"))
+                    out.append(f"{full}_bucket{_fmt_labels(lk)} {cell[i]}")
+                lk = _labelkey(dict(dict(k), le="+Inf"))
+                out.append(f"{full}_bucket{_fmt_labels(lk)} {cell[len(bk)]}")
+                out.append(f"{full}_sum{_fmt_labels(k)} {cell[-2]:g}")
+                out.append(f"{full}_count{_fmt_labels(k)} {cell[-1]}")
         return "\n".join(out) + "\n"
 
 

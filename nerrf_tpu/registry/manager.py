@@ -30,6 +30,7 @@ from __future__ import annotations
 import threading
 from typing import Optional
 
+from nerrf_tpu.flight.journal import DEFAULT_JOURNAL
 from nerrf_tpu.registry.config import RegistryConfig
 from nerrf_tpu.registry.guardrails import (
     PROMOTE,
@@ -44,7 +45,7 @@ from nerrf_tpu.tracing import span as trace_span
 class ModelManager:
     def __init__(self, store: ModelRegistry, lineage: str,
                  cfg: Optional[RegistryConfig] = None,
-                 registry=None, log=None) -> None:
+                 registry=None, log=None, journal=None) -> None:
         if registry is None:
             from nerrf_tpu.observability import DEFAULT_REGISTRY
 
@@ -54,6 +55,8 @@ class ModelManager:
         self.cfg = cfg or RegistryConfig()
         self._reg = registry
         self._log = log or (lambda msg: None)
+        self._journal = journal if journal is not None else DEFAULT_JOURNAL
+        self._shadow_obs = 0  # journal cadence for shadow-stat records
         self._service = None
         self._version: Optional[int] = None
         self._shadow_version: Optional[int] = None
@@ -151,6 +154,21 @@ class ModelManager:
             labels={"lineage": self.lineage},
             help="mean |p_shadow - p_live| over real nodes (score-"
                  "distribution drift)")
+        # journal the paired stats on a cadence (not per window — the ring
+        # is bounded and batch closes must survive a long shadow run); the
+        # flight recorder's shadow_disagreement trigger keys off this kind.
+        # The counter is scorer-thread-only in steady state; a racy reset
+        # from _start_shadow merely shifts the journal cadence by a window
+        # nerrflint: ok[lock-discipline] cadence counter: a torn read shifts journaling by one window, never corrupts state
+        self._shadow_obs += 1
+        # nerrflint: ok[lock-discipline] same cadence counter as the line above
+        if self._shadow_obs % 32 == 1:
+            self._journal.record(
+                "registry_shadow_stats", lineage=self.lineage,
+                version=version,
+                windows=self._shadow_obs,
+                disagreement_rate=round(snap["disagreement_rate"], 4),
+                score_drift=round(snap["score_drift"], 4))
 
     # -- the poll step --------------------------------------------------------
 
@@ -214,6 +232,10 @@ class ModelManager:
                     "registry_promotions_total",
                     labels={"lineage": self.lineage, "kind": "auto"},
                     help="candidate versions promoted to LIVE")
+                self._journal.record(
+                    "registry_promote", lineage=self.lineage,
+                    version=self._shadow_version, promotion="auto",
+                    reason=reason)
                 return self._apply(self._shadow_version, out,
                                    action="auto_promote")
             if verdict == VETO:
@@ -223,6 +245,9 @@ class ModelManager:
                     labels={"lineage": self.lineage},
                     help="shadow candidates rejected by a promotion "
                          "guardrail")
+                self._journal.record(
+                    "registry_veto", lineage=self.lineage,
+                    version=self._shadow_version, reason=reason)
                 self._log(f"registry: shadow v{self._shadow_version} "
                           f"vetoed — {reason}")
                 out.update(action="veto", vetoed=self._shadow_version)
@@ -278,6 +303,9 @@ class ModelManager:
             help="live param hot-swaps applied in-process (zero-recompile "
                  "pointer swaps under the batch lock)")
         self._stamp_info(version, previous=previous)
+        self._journal.record(
+            "registry_swap", lineage=self.lineage, version=version,
+            previous=previous, direction=direction, action=action)
         if self._shadow_version is not None and self._shadow_version <= version:
             self._retire_shadow()
         self._log(f"registry: live model -> v{version} "
@@ -315,6 +343,10 @@ class ModelManager:
             thr = None
         self._stats = make_stats(self.cfg, threshold=thr)
         self._shadow_version = version
+        self._shadow_obs = 0
+        self._journal.record(
+            "registry_shadow", lineage=self.lineage, version=version,
+            live=self._version)
         self._log(f"registry: shadow candidate v{version} staged "
                   f"(live v{self._version})")
         out.update(action="shadow_start", shadow=version)

@@ -38,8 +38,20 @@ class ModelRegistry:
     """The file-backed store.  Thread- and process-safe for its published
     surface: publish / promote / rollback / read."""
 
-    def __init__(self, root: str | Path) -> None:
+    def __init__(self, root: str | Path, journal=None) -> None:
         self.root = Path(root).absolute()
+        # flight journal for publish records; None → the process-wide
+        # DEFAULT_JOURNAL, resolved lazily at publish (keeps this module
+        # import-light and lets embedders with an isolated journal — the
+        # serve bench, tests — keep their records out of the shared ring)
+        self._journal = journal
+
+    def _journal_or_default(self):
+        if self._journal is None:
+            from nerrf_tpu.flight.journal import DEFAULT_JOURNAL
+
+            self._journal = DEFAULT_JOURNAL
+        return self._journal
 
     # -- paths ----------------------------------------------------------------
 
@@ -155,6 +167,9 @@ class ModelRegistry:
                     # the atomic claim: rename fails when a concurrent
                     # publisher took this number first — re-scan and retry
                     os.rename(tmp, self.version_dir(lineage, version))
+                    self._journal_or_default().record(
+                        "registry_publish", lineage=lineage,
+                        version=version, source=source or str(src))
                     return version
                 except OSError as e:
                     # ONLY a lost race (the target exists) is retryable;

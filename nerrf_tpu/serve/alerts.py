@@ -40,17 +40,27 @@ class WindowAlert:
     # registry model version that scored the window (None when the service
     # runs without a model manager)
     model_version: Optional[int] = None
+    # the window's flight/span join key (flight.journal.make_trace_id):
+    # an alert is joinable to its batch's span tree, journal records and
+    # SLO exemplars — alerts are no longer anonymous once demuxed
+    trace_id: str = ""
 
 
 class AlertSink:
     """Bounded, never-blocking alert queue + per-stream detection capture."""
 
-    def __init__(self, slots: int = 256, registry=None) -> None:
+    def __init__(self, slots: int = 256, registry=None,
+                 journal=None) -> None:
         if registry is None:
             from nerrf_tpu.observability import DEFAULT_REGISTRY
 
             registry = DEFAULT_REGISTRY
+        if journal is None:
+            from nerrf_tpu.flight.journal import DEFAULT_JOURNAL
+
+            journal = DEFAULT_JOURNAL
         self._reg = registry
+        self._journal = journal
         self._lock = threading.Lock()
         self._alerts: deque = deque(maxlen=max(slots, 1))
         self.detections: Dict[str, object] = {}
@@ -61,12 +71,19 @@ class AlertSink:
         same newest-evidence-wins policy as admission drop-oldest."""
         with self._lock:
             overflow = len(self._alerts) == self._alerts.maxlen
+            evicted = self._alerts[0] if overflow else None
             self._alerts.append(alert)
         if overflow:
             self._reg.counter_inc(
                 "serve_demux_overflows_total",
                 help="window alerts evicted because the alert sink was full "
                      "(slow consumer); scoring is unaffected")
+            # journal the EVICTED alert (the one the operator lost), not
+            # the incoming one — drop-burst triggers key off these records
+            self._journal.record(
+                "demux_drop", stream=evicted.stream,
+                window_id=evicted.window_idx, trace_id=evicted.trace_id,
+                reason="sink_full", max_prob=round(evicted.max_prob, 4))
         return not overflow
 
     def on_detection(self, stream: str, detection) -> None:

@@ -36,6 +36,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from nerrf_tpu.flight.journal import DEFAULT_JOURNAL
 from nerrf_tpu.serve.config import Bucket, ServeConfig, bucket_tag
 from nerrf_tpu.tracing import span as trace_span
 
@@ -61,6 +62,12 @@ class WindowRequest:
     # set (under the batcher lock) when assembled into a closing batch:
     # an in-flight request can no longer be dropped, only awaited
     inflight: bool = False
+    # flight/SLO plane: the window's journal/span join key, plus the
+    # per-stage event-time stamps (admit → packed → scorer pickup) the
+    # SLO tracker turns into budget-burn attribution
+    trace_id: str = ""
+    t_packed: float = 0.0
+    t_device: float = 0.0
 
 
 @dataclasses.dataclass
@@ -86,6 +93,10 @@ class ScoredWindow:
     # model manager) — the per-window stamp the swap bench asserts flips
     # at exactly one batch boundary
     model_version: Optional[int] = None
+    # flight/SLO plane (mirrors WindowRequest): join key + stage stamps
+    trace_id: str = ""
+    t_packed: float = 0.0
+    t_device: float = 0.0
 
 
 class MicroBatcher:
@@ -104,6 +115,7 @@ class MicroBatcher:
         registry=None,
         on_scored: Optional[Callable[[List[ScoredWindow]], None]] = None,
         on_failed: Optional[Callable[[List[WindowRequest], BaseException], None]] = None,
+        journal=None,
     ) -> None:
         if registry is None:
             from nerrf_tpu.observability import DEFAULT_REGISTRY
@@ -112,6 +124,7 @@ class MicroBatcher:
         self._score_fn = score_fn
         self._cfg = cfg
         self._reg = registry
+        self._journal = journal if journal is not None else DEFAULT_JOURNAL
         self._on_scored = on_scored or (lambda scored: None)
         self._on_failed = on_failed or (lambda reqs, exc: None)
         self._lock = threading.Lock()
@@ -188,6 +201,7 @@ class MicroBatcher:
                     r = dq.popleft()
                     if not r.dropped:
                         r.inflight = True
+                        r.t_packed = now  # SLO stage stamp: queue ends here
                         reqs.append(r)
                 if not reqs:
                     continue
@@ -207,7 +221,7 @@ class MicroBatcher:
             # depth must be a locked read, not a racy .get
             depth = self._live.get(bucket, 0)
         with trace_span("serve_batch_close", bucket=tag, cause=cause,
-                        windows=len(reqs)):
+                        windows=len(reqs)) as sp:
             self._reg.counter_inc(
                 "serve_batches_total", labels={"bucket": tag, "cause": cause},
                 help="shared device batches closed, by bucket and close cause")
@@ -219,6 +233,17 @@ class MicroBatcher:
                 "serve_queue_depth", depth,
                 labels={"bucket": tag},
                 help="windows pending per capacity bucket")
+            # the batch-close record the flight recorder's bundles key off:
+            # bucket, close cause, occupancy vs padded slots, post-close
+            # depth, and every packed window's trace ID (span join keys)
+            rec = self._journal.record(
+                "batch_close", bucket=tag, cause=cause,
+                occupancy=len(reqs),
+                padding=self._cfg.batch_size - len(reqs),
+                depth_after=depth,
+                streams=sorted({r.stream for r in reqs}),
+                trace_ids=[r.trace_id for r in reqs if r.trace_id])
+            sp.args["journal_seq"] = rec.seq
         self._ready.put((bucket, reqs, cause))
 
     # -- scoring --------------------------------------------------------------
@@ -242,6 +267,9 @@ class MicroBatcher:
                      "during warmup (steady state must stay at 0)")
             self.mark_warm(bucket)
         batch = self._stack(reqs)
+        t_device = time.perf_counter()
+        for r in reqs:
+            r.t_device = t_device  # SLO stage stamp: scorer pickup
         try:
             with trace_span("serve_device_score", device=True, bucket=tag,
                             windows=len(reqs)):
@@ -257,6 +285,10 @@ class MicroBatcher:
             self._reg.counter_inc(
                 "serve_batch_failures_total", labels={"bucket": tag},
                 help="device batches whose scoring raised")
+            self._journal.record(
+                "batch_failed", bucket=tag, windows=len(reqs),
+                error=f"{type(exc).__name__}: {exc}",
+                trace_ids=[r.trace_id for r in reqs if r.trace_id])
             self._on_failed(reqs, exc)
             return
         now = time.perf_counter()
@@ -280,7 +312,8 @@ class MicroBatcher:
                     probs=probs[j], node_type=s["node_type"],
                     node_key=s["node_key"], node_mask=s["node_mask"],
                     t_admit=r.t_admit, t_scored=now, late=late,
-                    model_version=version))
+                    model_version=version, trace_id=r.trace_id,
+                    t_packed=r.t_packed, t_device=r.t_device))
                 r.sample = None  # release the padded sample's memory
             self._reg.counter_inc(
                 "serve_windows_scored_total", len(reqs),

@@ -36,6 +36,8 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from nerrf_tpu.data.loaders import Trace
+from nerrf_tpu.flight.journal import DEFAULT_JOURNAL, fingerprint, make_trace_id
+from nerrf_tpu.flight.slo import SLOTracker
 from nerrf_tpu.graph.builder import NODE_TYPE_FILE, measure_window
 from nerrf_tpu.models import NerrfNet
 from nerrf_tpu.pipeline import (
@@ -95,6 +97,8 @@ class OnlineDetectionService:
         registry=None,
         alert_sink: Optional[AlertSink] = None,
         window_log: Optional[list] = None,
+        journal=None,
+        flight=None,
     ) -> None:
         if registry is None:
             from nerrf_tpu.observability import DEFAULT_REGISTRY
@@ -105,11 +109,22 @@ class OnlineDetectionService:
         self._model = model
         self._eval_fn = make_eval_fn(model)
         self._reg = registry
+        self._journal = journal if journal is not None else DEFAULT_JOURNAL
+        # the SLO plane: per-stream e2e histograms + per-stage budget burn
+        # from the stage stamps every window carries (flight/slo.py)
+        self._slo = SLOTracker(self.cfg.window_deadline_sec,
+                               registry=registry, journal=self._journal)
+        # optional FlightRecorder (flight/recorder.py): fed per-window e2e
+        # latencies for the p99-breach trigger; journal records reach it
+        # through its own subscription
+        self._flight = flight
         self.sink = alert_sink or AlertSink(self.cfg.alert_queue_slots,
-                                            registry=registry)
+                                            registry=registry,
+                                            journal=self._journal)
         self._batcher = MicroBatcher(
             score_fn=self._score_fn, cfg=self.cfg, registry=registry,
-            on_scored=self._on_scored, on_failed=self._on_failed)
+            on_scored=self._on_scored, on_failed=self._on_failed,
+            journal=self._journal)
         self._lock = threading.Lock()
         self._streams: Dict[str, StreamHandle] = {}
         self._warm = False
@@ -200,6 +215,32 @@ class OnlineDetectionService:
     def attach_manager(self, manager) -> None:
         self._manager = manager
 
+    def attach_flight(self, recorder) -> None:
+        """Bind a FlightRecorder: per-window e2e latencies feed its
+        p99-breach trigger (journal-record triggers need no binding — the
+        recorder subscribes to the journal itself)."""
+        self._flight = recorder
+
+    @property
+    def slo(self) -> SLOTracker:
+        return self._slo
+
+    def flight_info(self) -> dict:
+        """Live identity for a flight bundle's manifest: which model is
+        serving, what the ladder/threshold are — captured at dump time."""
+        info = {
+            "model_version": (f"v{self._live_version}"
+                              if self._live_version is not None else None),
+            "threshold": self.cfg.threshold,
+            "buckets": [bucket_tag(b) for b in self.cfg.buckets],
+            "config_fingerprint": fingerprint(self.cfg),
+        }
+        if self._manager is not None:
+            info["lineage"] = self._manager.lineage
+            if self._manager.shadow_version is not None:
+                info["shadow_version"] = f"v{self._manager.shadow_version}"
+        return info
+
     def swap_params(self, params, version: Optional[int] = None,
                     threshold: Optional[float] = None) -> None:
         """Zero-downtime hot-swap: validate the new pytree against the one
@@ -268,11 +309,24 @@ class OnlineDetectionService:
     # -- lifecycle ------------------------------------------------------------
 
     def start(self, log=None) -> "OnlineDetectionService":
+        # config + model fingerprints up front: the journal tail in any
+        # later bundle identifies exactly what was serving
+        self._journal.record(
+            "config", config_fingerprint=fingerprint(self.cfg),
+            buckets=[bucket_tag(b) for b in self.cfg.buckets],
+            batch_size=self.cfg.batch_size,
+            batch_close_sec=self.cfg.batch_close_sec,
+            window_deadline_sec=self.cfg.window_deadline_sec,
+            threshold=self.cfg.threshold,
+            model_fingerprint=(fingerprint(self._model.cfg)
+                               if self._model is not None else None))
         if self.cfg.warmup_on_start:
             self._warmup(log=log)
         self._warm = True
         self._batcher.start()
         self._admission_open = True
+        self._journal.record("readiness", ready=True,
+                             warmup_seconds=dict(self.warmup_seconds))
         return self
 
     def ready(self):
@@ -294,6 +348,8 @@ class OnlineDetectionService:
         return True, "ok", extra
 
     def stop(self, drain: bool = True) -> None:
+        if self._admission_open:
+            self._journal.record("readiness", ready=False, reason="stopping")
         self._admission_open = False
         self._batcher.stop(drain=drain)
 
@@ -348,14 +404,22 @@ class OnlineDetectionService:
                     break
                 handle.cond.wait(timeout=min(remaining, 0.25))
             # still-queued leftovers (never assembled): drop cleanly
+            leave_drops = []
             for idx in [i for i, r in handle.live.items()
                         if self._batcher.mark_dropped(r)]:
-                del handle.live[idx]
+                req = handle.live.pop(idx)
                 handle.dropped += 1
                 self._reg.counter_inc(
                     "serve_admission_dropped_total",
                     labels={"reason": "leave"},
                     help="windows dropped at the serve admission boundary")
+                leave_drops.append((idx, req.trace_id))
+        # journal OUTSIDE handle.cond (see _admit: a flight-recorder dump
+        # on a drop record must never run while the cond is held)
+        for idx, tid in leave_drops:
+            self._journal.record(
+                "admission_drop", stream=handle.id, window_id=idx,
+                trace_id=tid, reason="leave")
         det = self._finalize(handle)
         with self._lock:
             self._streams.pop(stream_id, None)
@@ -434,7 +498,9 @@ class OnlineDetectionService:
                 raise KeyError(f"stream {stream_id!r} not joined") from None
 
     def _admit(self, handle: StreamHandle, idx: int, lo: int, hi: int) -> None:
-        with trace_span("serve_admit", stream=handle.id, window=idx) as sp:
+        trace_id = make_trace_id(handle.id, idx, lo)
+        with trace_span("serve_admit", stream=handle.id, window=idx,
+                        trace_id=trace_id) as sp:
             if not self._admission_open:
                 # the batcher is stopped/stopping: a window admitted now
                 # would queue forever and wedge this stream's leave()
@@ -443,6 +509,9 @@ class OnlineDetectionService:
                     "serve_admission_dropped_total",
                     labels={"reason": "closed"},
                     help="windows dropped at the serve admission boundary")
+                self._journal.record(
+                    "admission_drop", stream=handle.id, window_id=idx,
+                    trace_id=trace_id, reason="closed")
                 return
             # measure/lower from the window's slice of the stream, not the
             # whole accumulated history — O(window) admission, not
@@ -459,6 +528,10 @@ class OnlineDetectionService:
                     "serve_admission_dropped_total",
                     labels={"reason": "oversize"},
                     help="windows dropped at the serve admission boundary")
+                self._journal.record(
+                    "admission_drop", stream=handle.id, window_id=idx,
+                    trace_id=trace_id, reason="oversize",
+                    nodes=int(n), edges=int(e), files=int(files))
                 return
             sp.args["bucket"] = bucket_tag(bucket)
             sample, _stats = window_sample(
@@ -475,7 +548,9 @@ class OnlineDetectionService:
             req = WindowRequest(
                 stream=handle.id, window_idx=idx, lo_ns=lo, hi_ns=hi,
                 bucket=bucket, sample=sample, t_admit=now,
-                deadline=now + self.cfg.window_deadline_sec)
+                deadline=now + self.cfg.window_deadline_sec,
+                trace_id=trace_id)
+            dropped_old = None
             with handle.cond:
                 if len(handle.live) >= self.cfg.stream_queue_slots:
                     # drop-OLDEST: under sustained overload the newest
@@ -490,9 +565,20 @@ class OnlineDetectionService:
                                 labels={"reason": "backpressure"},
                                 help="windows dropped at the serve "
                                      "admission boundary")
+                            dropped_old = (old_idx, old.trace_id)
                             break
                 handle.live[idx] = req
                 handle.admitted += 1
+            if dropped_old is not None:
+                # journal OUTSIDE handle.cond: listeners (the flight
+                # recorder) may dump a bundle on this record, and the
+                # scorer's demux needs the cond — a dump held under it
+                # would stall scoring exactly during the overload that
+                # fired the trigger
+                self._journal.record(
+                    "admission_drop", stream=handle.id,
+                    window_id=dropped_old[0], trace_id=dropped_old[1],
+                    reason="backpressure")
             self._reg.counter_inc(
                 "serve_windows_admitted_total",
                 help="windows admitted into the micro-batcher")
@@ -503,11 +589,25 @@ class OnlineDetectionService:
     def _on_scored(self, scored: List[ScoredWindow]) -> None:
         alert_thr = (self.cfg.threshold if self.cfg.threshold is not None
                      else 0.5)
+        t_demux = time.perf_counter()
         for s in scored:
             if self._window_log is not None:
                 self._window_log.append(
                     (s.stream, s.window_idx, s.t_scored - s.t_admit, s.late,
                      s.model_version))
+            # SLO accounting from the stage stamps the window carried:
+            # admit → packed (queue) → scorer pickup (pack) → scored
+            # (device) → here (demux); e2e runs admit → demux
+            e2e = t_demux - s.t_admit
+            self._slo.observe(
+                s.stream, s.trace_id, s.window_idx,
+                stages={"queue": s.t_packed - s.t_admit,
+                        "pack": s.t_device - s.t_packed,
+                        "device": s.t_scored - s.t_device,
+                        "demux": t_demux - s.t_scored},
+                e2e_sec=e2e)
+            if self._flight is not None:
+                self._flight.observe_window(s.stream, s.trace_id, e2e)
             with self._lock:
                 handle = self._streams.get(s.stream)
             if handle is not None:
@@ -531,7 +631,7 @@ class OnlineDetectionService:
                 lo_ns=s.lo_ns, hi_ns=s.hi_ns,
                 max_prob=float(s.probs[mask].max()), hot=hot,
                 t_admit=s.t_admit, t_scored=s.t_scored, late=s.late,
-                model_version=s.model_version))
+                model_version=s.model_version, trace_id=s.trace_id))
 
     def _on_failed(self, reqs: List[WindowRequest], exc: BaseException) -> None:
         for r in reqs:

@@ -426,6 +426,16 @@ def train_nerrfnet(
 ) -> TrainResult:
     cfg = cfg or TrainConfig()
     model = NerrfNet(cfg.model)
+    # config+model fingerprints into the flight journal: a run's identity
+    # survives into any later incident bundle (which retrained config
+    # produced the weights a serve pod is about to swap in)
+    from nerrf_tpu.flight.journal import DEFAULT_JOURNAL, fingerprint
+
+    DEFAULT_JOURNAL.record(
+        "train_start", config_fingerprint=fingerprint(cfg),
+        model_fingerprint=fingerprint(cfg.model),
+        steps=cfg.num_steps, batch_size=cfg.batch_size,
+        windows=len(train_ds), seed=cfg.seed)
     rng = jax.random.PRNGKey(cfg.seed)
     rng, init_rng = jax.random.split(rng)
     with DEFAULT_TRACER.span("train_setup", device=True):
@@ -542,6 +552,10 @@ def train_nerrfnet(
         # HBM, so stream per batch in that (diagnostic) case
         resident=None if eval_ds is not None else False,
     )
+    DEFAULT_JOURNAL.record(
+        "train_done", config_fingerprint=fingerprint(cfg),
+        steps_per_sec=round(steps_per_sec, 3),
+        metrics={k: round(float(v), 4) for k, v in metrics.items()})
     return TrainResult(state=state, metrics=metrics, steps_per_sec=steps_per_sec,
                        history=history)
 

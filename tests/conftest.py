@@ -20,6 +20,17 @@ _flags = os.environ.get("XLA_FLAGS", "")
 if not _real and "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 
+if not _real:
+    # Keep the persistent compilation cache OUT of CPU test runs.  In-process
+    # CLI tests (test_cli drives cli.main directly) call
+    # enable_compilation_cache(), arming the on-disk cache for the whole
+    # pytest process; XLA:CPU's executable serialize/deserialize path then
+    # aborts/segfaults this host (observed: test_cli + test_elastic kills the
+    # run inside train_elastic's cached step_by_idx, reproducibly, at any
+    # commit — and never with the cache disabled).  Chip-gated queue runs
+    # (_real) keep the cache: there it saves real compile minutes.
+    os.environ.setdefault("NERRF_NO_COMPILE_CACHE", "1")
+
 import jax  # noqa: E402
 
 if not _real:

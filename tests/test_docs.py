@@ -9,7 +9,8 @@ def test_all_pages_present_and_linked(repo_root):
     pages = {p.name for p in docs.glob("*.md")}
     assert {"index.md", "quick-start.md", "architecture.md", "ingest.md",
             "models.md", "planner.md", "rollback.md", "scaling.md",
-            "operations.md", "benchmarks.md", "configuration.md"} <= pages
+            "operations.md", "benchmarks.md", "configuration.md",
+            "flight-recorder.md"} <= pages
     # every relative .md link in every page resolves
     for p in docs.glob("*.md"):
         for target in re.findall(r"\]\(([\w\-]+\.md)\)", p.read_text()):

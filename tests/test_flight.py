@@ -1,0 +1,538 @@
+"""Flight plane: journal ring, SLO accounting, anomaly-triggered recorder,
+crash hooks, offline doctor, and the serve-path integration."""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from nerrf_tpu.flight import (
+    EventJournal,
+    FlightConfig,
+    FlightRecorder,
+    SLOTracker,
+    install_crash_handlers,
+    make_trace_id,
+)
+from nerrf_tpu.flight.doctor import doctor_main, format_report, read_bundle
+from nerrf_tpu.flight.journal import load_journal
+from nerrf_tpu.observability import MetricsRegistry
+from nerrf_tpu.tracing import Tracer
+
+
+def _recorder(tmp_path, reg=None, journal=None, slo=None, **cfg_kw):
+    reg = reg or MetricsRegistry(namespace="t")
+    journal = journal or EventJournal(registry=reg)
+    cfg_kw.setdefault("out_dir", str(tmp_path / "bundles"))
+    cfg_kw.setdefault("min_interval_sec", 300.0)
+    rec = FlightRecorder(FlightConfig(**cfg_kw), registry=reg,
+                         journal=journal, tracer=Tracer(registry=reg),
+                         slo=slo)
+    return rec, journal, reg
+
+
+# -- journal ------------------------------------------------------------------
+
+def test_journal_ring_is_bounded_with_monotonic_seq():
+    reg = MetricsRegistry(namespace="t")
+    j = EventJournal(capacity=8, registry=reg)
+    for i in range(20):
+        j.record("batch_close", bucket="b", occupancy=i)
+    tail = j.tail()
+    assert len(tail) == 8
+    assert [r.seq for r in tail] == list(range(13, 21))  # newest 8, in order
+    assert tail[-1].data["occupancy"] == 19
+    assert j.seq == 20
+    assert reg.value("flight_journal_records_total",
+                     labels={"kind": "batch_close"}) == 20
+
+
+def test_journal_tail_filters_and_jsonl_roundtrip(tmp_path):
+    j = EventJournal(registry=MetricsRegistry())
+    j.record("batch_close", bucket="b")
+    j.record("admission_drop", stream="s0", window_id=3,
+             trace_id="w-abc", reason="backpressure")
+    j.record("readiness", ready=True)
+    assert [r.kind for r in j.tail(kinds=("admission_drop",))] \
+        == ["admission_drop"]
+    assert [r.seq for r in j.tail(since_seq=2)] == [3]
+    path = j.write(tmp_path / "journal.jsonl")
+    back = load_journal(path)
+    assert [(r.seq, r.kind) for r in back] == \
+        [(1, "batch_close"), (2, "admission_drop"), (3, "readiness")]
+    assert back[1].stream == "s0" and back[1].trace_id == "w-abc"
+    assert back[1].data == {"reason": "backpressure"}
+
+
+def test_journal_listeners_fire_outside_lock_and_swallow_errors():
+    j = EventJournal(registry=MetricsRegistry())
+    got = []
+
+    def listener(rec):
+        # re-entrancy: a listener may itself record (the recorder journals
+        # its own bundles) — deadlock here means the lock is held
+        if rec.kind != "echo":
+            j.record("echo")
+        got.append(rec.kind)
+
+    def boom(rec):
+        raise RuntimeError("listener exploded")
+
+    j.subscribe(boom)
+    j.subscribe(listener)
+    j.record("batch_close")
+    assert "batch_close" in got and "echo" in got
+    j.unsubscribe(listener)
+    j.record("batch_close")
+    assert got.count("batch_close") == 1
+
+
+def test_make_trace_id_is_deterministic_and_distinct():
+    a = make_trace_id("s0", 3, 1000)
+    assert a == make_trace_id("s0", 3, 1000)
+    assert a != make_trace_id("s0", 4, 1000)
+    assert a != make_trace_id("s1", 3, 1000)
+    assert a.startswith("w-")
+
+
+# -- SLO tracker --------------------------------------------------------------
+
+def test_slo_tracker_exports_histograms_burn_and_exemplar():
+    reg = MetricsRegistry(namespace="t")
+    j = EventJournal(registry=reg)
+    slo = SLOTracker(deadline_sec=1.0, registry=reg, journal=j)
+    for i in range(10):
+        slo.observe("s0", f"w-{i:03d}", i,
+                    stages={"queue": 0.02, "pack": 0.01, "device": 0.05,
+                            "demux": 0.02},
+                    e2e_sec=0.1)
+    # the slowest window becomes the stream's exemplar
+    slo.observe("s0", "w-slow", 99,
+                stages={"queue": 0.2, "pack": 0.1, "device": 1.5,
+                        "demux": 0.2},
+                e2e_sec=2.0)
+    assert reg.value("slo_e2e_seconds", labels={"stream": "s0"},
+                     stat="count") == 11
+    assert reg.value("slo_stage_seconds", labels={"stage": "device"},
+                     stat="count") == 11
+    burn = reg.value("slo_budget_burn_ratio",
+                     labels={"stream": "s0", "stage": "device"})
+    assert 0 < burn < 1  # mean device share of the 1 s budget
+    assert reg.value("slo_breaches_total", labels={"stream": "s0"}) == 1
+    assert slo.exemplar("s0") == ("w-slow", 2.0)
+    assert slo.exemplar("missing") == (None, None)
+    # the breach journaled with its trace id (the alert→span join key)
+    breaches = j.tail(kinds=("slo_breach",))
+    assert len(breaches) == 1 and breaches[0].trace_id == "w-slow"
+    snap = slo.snapshot()
+    s0 = snap["per_stream"]["s0"]
+    assert s0["count"] == 11 and s0["breaches"] == 1
+    assert s0["p50_ms"] == 100.0 and s0["p99_ms"] == 2000.0
+    assert s0["exemplar_trace_id"] == "w-slow"
+    assert set(s0["budget_burn"]) == {"queue", "pack", "device", "demux"}
+    rendered = reg.render()
+    assert "t_slo_e2e_seconds_bucket" in rendered
+    assert 'stream="s0"' in rendered
+
+
+def test_slo_budget_burn_and_exemplar_are_trailing():
+    """A regression must move the burn gauge within ONE trailing window
+    (not fight a day of history), and the exemplar must age out with its
+    window so its trace ID always joins to evidence the rings still hold."""
+    reg = MetricsRegistry(namespace="t")
+    slo = SLOTracker(deadline_sec=1.0, registry=reg,
+                     journal=EventJournal(registry=reg), trailing=4)
+    for i in range(4):
+        slo.observe("s", f"w-slow{i}", i, stages={"device": 1.0},
+                    e2e_sec=1.0 + 0.1 * i)
+    assert reg.value("slo_budget_burn_ratio",
+                     labels={"stream": "s", "stage": "device"}) \
+        == pytest.approx(1.0)
+    assert slo.exemplar("s")[0] == "w-slow3"
+    # recovery: 4 fast windows fully displace the slow history
+    for i in range(4):
+        slo.observe("s", f"w-fast{i}", 10 + i, stages={"device": 0.0},
+                    e2e_sec=0.01)
+    assert reg.value("slo_budget_burn_ratio",
+                     labels={"stream": "s", "stage": "device"}) \
+        == pytest.approx(0.0)
+    trace, e2e = slo.exemplar("s")
+    assert trace.startswith("w-fast") and e2e == 0.01  # slow spike aged out
+    # count stays all-time (the snapshot's volume figure), window does not
+    assert slo.snapshot()["per_stream"]["s"]["count"] == 8
+
+
+def test_slo_tracker_bounds_stream_cardinality():
+    """A resident pod's reconnect sessions mint stream IDs forever; beyond
+    max_streams the LRU stream's state AND registry series are retired."""
+    reg = MetricsRegistry(namespace="t")
+    slo = SLOTracker(deadline_sec=1.0, registry=reg,
+                     journal=EventJournal(registry=reg), max_streams=2)
+    for sid in ("s#0", "s#1", "s#2"):
+        slo.observe(sid, f"w-{sid}", 0, stages={"device": 0.1}, e2e_sec=2.0)
+    assert slo.exemplar("s#0") == (None, None)  # evicted (LRU)
+    assert set(slo.snapshot()["per_stream"]) == {"s#1", "s#2"}
+    text = reg.render()
+    assert 'stream="s#0"' not in text  # series retired, not just frozen
+    assert 'stream="s#1"' in text and 'stream="s#2"' in text
+    # touching s#1 refreshes it: s#2 becomes the LRU victim next
+    slo.observe("s#1", "w2", 1, stages={}, e2e_sec=0.1)
+    slo.observe("s#3", "w3", 0, stages={}, e2e_sec=0.1)
+    assert set(slo.snapshot()["per_stream"]) == {"s#1", "s#3"}
+
+
+def test_registry_remove_series_drops_one_labeled_series():
+    reg = MetricsRegistry(namespace="t")
+    reg.histogram_observe("lat_seconds", 0.1, labels={"stream": "a"},
+                          help="lat")
+    reg.histogram_observe("lat_seconds", 0.2, labels={"stream": "b"})
+    reg.gauge_set("g", 1.0, labels={"stream": "a"}, help="g")
+    assert reg.remove_series("lat_seconds", {"stream": "a"}) is True
+    assert reg.remove_series("lat_seconds", {"stream": "a"}) is False
+    text = reg.render()
+    assert 't_lat_seconds_bucket{le="0.5",stream="b"} 1' in text
+    assert 't_lat_seconds_count{stream="a"}' not in text
+    assert 't_g{stream="a"} 1' in text  # other metrics untouched
+    assert reg.value("lat_seconds", labels={"stream": "a"},
+                     stat="count") == 0
+    assert reg.value("lat_seconds", labels={"stream": "b"},
+                     stat="count") == 1
+
+
+def test_slo_tracker_clamps_negative_stage_jitter():
+    slo = SLOTracker(deadline_sec=1.0, registry=MetricsRegistry(),
+                     journal=EventJournal(registry=MetricsRegistry()))
+    slo.observe("s", None, 0, stages={"queue": -1e-6}, e2e_sec=-0.001)
+    snap = slo.snapshot()["per_stream"]["s"]
+    assert snap["p50_ms"] == 0.0
+    assert all(v >= 0 for v in snap["budget_burn"].values())
+
+
+# -- recorder triggers --------------------------------------------------------
+
+def test_p99_breach_fires_exactly_one_rate_limited_bundle(tmp_path):
+    rec, journal, reg = _recorder(tmp_path, p99_breach_sec=0.5,
+                                  p99_min_count=8)
+    journal.record("batch_close", bucket="b", occupancy=4,
+                   trace_ids=["w-slow"])
+    for _ in range(16):
+        rec.observe_window("s0", "w-slow", 2.5)
+    bundles = [p for p in os.listdir(tmp_path / "bundles")
+               if p.startswith("bundle-")]
+    assert len(bundles) == 1 and bundles[0].endswith("p99_breach")
+    assert reg.value("flight_bundles_total",
+                     labels={"trigger": "p99_breach"}) == 1
+    assert reg.value("flight_triggers_suppressed_total",
+                     labels={"trigger": "p99_breach"}) >= 1
+    bundle = read_bundle(tmp_path / "bundles" / bundles[0])
+    assert bundle["manifest"]["trigger"] == "p99_breach"
+    assert bundle["manifest"]["context"]["trace_id"] == "w-slow"
+    # the offending batch-close record is in the journal tail
+    assert any(r.kind == "batch_close"
+               and "w-slow" in r.data.get("trace_ids", [])
+               for r in bundle["records"])
+    rec.close()
+
+
+def test_p99_trigger_needs_min_count_and_disabled_without_threshold(tmp_path):
+    rec, _, _ = _recorder(tmp_path, p99_breach_sec=0.5, p99_min_count=8)
+    for _ in range(7):
+        rec.observe_window("s0", None, 9.0)  # under the min-count gate
+    assert not (tmp_path / "bundles").exists()
+    rec.close()
+    rec2, _, _ = _recorder(tmp_path, p99_breach_sec=None)
+    for _ in range(50):
+        rec2.observe_window("s0", None, 9.0)  # trigger disarmed
+    assert not (tmp_path / "bundles").exists()
+    rec2.close()
+
+
+def test_drop_burst_trigger(tmp_path):
+    rec, journal, _ = _recorder(tmp_path, drop_burst_n=5, drop_burst_sec=10.0)
+    for i in range(4):
+        journal.record("admission_drop", stream="s0", window_id=i,
+                       reason="backpressure")
+    assert not (tmp_path / "bundles").exists()  # below the burst threshold
+    journal.record("demux_drop", stream="s0", window_id=4,
+                   reason="sink_full")  # both drop kinds count
+    bundles = os.listdir(tmp_path / "bundles")
+    assert len(bundles) == 1 and bundles[0].endswith("drop_burst")
+    rec.close()
+
+
+def test_veto_and_disagreement_triggers(tmp_path):
+    rec, journal, _ = _recorder(tmp_path, disagreement_spike=0.3,
+                                disagreement_min_windows=8)
+    journal.record("registry_veto", lineage="default", version=3,
+                   reason="disagreement_rate 0.41 > 0.25")
+    journal.record("registry_shadow_stats", lineage="default", version=4,
+                   windows=1, disagreement_rate=0.9,
+                   score_drift=0.4)  # first-window noise: min-windows gated
+    journal.record("registry_shadow_stats", lineage="default", version=4,
+                   windows=64, disagreement_rate=0.55, score_drift=0.2)
+    journal.record("registry_shadow_stats", lineage="default", version=4,
+                   windows=96, disagreement_rate=0.01,
+                   score_drift=0.0)  # below spike
+    names = sorted(os.listdir(tmp_path / "bundles"))
+    assert len(names) == 2
+    assert {n.rsplit("-", 1)[-1] for n in names} \
+        == {"guardrail_veto", "shadow_disagreement"}
+    # the bundle that fired is the sustained one, not the noise spike
+    man = json.loads((tmp_path / "bundles"
+                      / [n for n in names if n.endswith("disagreement")][0]
+                      / "manifest.json").read_text())
+    assert man["context"]["windows"] == 64
+    rec.close()
+
+
+def test_bundles_are_atomic_bounded_and_self_contained(tmp_path):
+    rec, journal, reg = _recorder(tmp_path, max_bundles=3,
+                                  min_interval_sec=0.0)
+    reg.counter_inc("windows_total", 7, help="windows")
+    journal.record("config", config_fingerprint="abc123")
+    for i in range(6):
+        rec.trigger("p99_breach", f"incident {i}", context={"i": i})
+    root = tmp_path / "bundles"
+    names = sorted(os.listdir(root))
+    assert not [n for n in names if n.endswith(".tmp")]  # atomic: no torn dir
+    bundles = [n for n in names if n.startswith("bundle-")]
+    assert len(bundles) == 3  # disk bound enforced, oldest deleted
+    for name in bundles:
+        files = set(os.listdir(root / name))
+        assert {"manifest.json", "journal.jsonl", "trace.json",
+                "metrics.prom"} <= files
+        man = json.loads((root / name / "manifest.json").read_text())
+        assert man["env"]["python"] and man["env"]["pid"] == os.getpid()
+        assert "windows_total 7" in (root / name / "metrics.prom").read_text()
+    # the newest bundle survived (retention deletes from the old end)
+    newest = json.loads((root / bundles[-1] / "manifest.json").read_text())
+    assert newest["context"]["i"] == 5
+    rec.close()
+
+
+def test_failed_dump_leaves_no_tmp_behind(tmp_path, monkeypatch):
+    """A dump that dies mid-write (ENOSPC) must remove its partial .tmp —
+    each dump mints a fresh name, so an orphan would evade retention and
+    erode the disk bound forever."""
+    rec, journal, reg = _recorder(tmp_path, min_interval_sec=0.0)
+    monkeypatch.setattr(rec._tracer, "chrome_trace",
+                        lambda: (_ for _ in ()).throw(OSError("disk full")))
+    assert rec.trigger("p99_breach", "spike") is None  # swallowed, logged
+    root = tmp_path / "bundles"
+    assert not any(e.endswith(".tmp") for e in os.listdir(root))
+    assert reg.value("flight_bundles_total",
+                     labels={"trigger": "p99_breach"}) == 0
+    # recovery: the next dump (disk freed) succeeds normally
+    monkeypatch.undo()
+    assert rec.trigger("p99_breach", "spike again") is not None
+    rec.close()
+
+
+def test_failed_dump_does_not_consume_the_rate_limit(tmp_path, monkeypatch):
+    """A dump that fails (volume not mounted yet at pod start) must leave
+    the per-trigger interval unconsumed: the next firing retries instead
+    of taking the suppressed path for min_interval_sec with zero bundles
+    on disk while the journal/span rings wrap past the evidence."""
+    rec, journal, reg = _recorder(tmp_path, min_interval_sec=3600.0)
+    monkeypatch.setattr(rec._tracer, "chrome_trace",
+                        lambda: (_ for _ in ()).throw(OSError("disk full")))
+    assert rec.trigger("p99_breach", "spike") is None
+    monkeypatch.undo()  # disk freed — the re-fire must dump, not suppress
+    assert rec.trigger("p99_breach", "spike sustained") is not None
+    assert reg.value("flight_bundles_total",
+                     labels={"trigger": "p99_breach"}) == 1
+    # and the interval IS consumed by the successful dump
+    assert rec.trigger("p99_breach", "still breaching") is None
+    assert reg.value("flight_triggers_suppressed_total",
+                     labels={"trigger": "p99_breach"}) == 1
+    rec.close()
+
+
+def test_journal_exception_helper_produces_a_bundle(tmp_path):
+    """The shared capture path the serve CLI uses for MAIN-thread crashes
+    (whose finally uninstalls the excepthook before it could ever fire):
+    journaling the exception directly must still produce the bundle."""
+    from nerrf_tpu.flight.recorder import journal_exception
+
+    rec, journal, _ = _recorder(tmp_path)
+    try:
+        raise RuntimeError("main thread died in the summary writer")
+    except RuntimeError as e:
+        journal_exception(journal, type(e), e, e.__traceback__, "main")
+    recs = journal.tail(kinds=("exception",))
+    assert len(recs) == 1 and recs[0].stream == "main"
+    assert "summary writer" in recs[0].data["message"]
+    names = [n for n in os.listdir(tmp_path / "bundles")
+             if n.startswith("bundle-")]
+    assert len(names) == 1 and names[0].endswith("exception")
+    rec.close()
+
+
+def test_recorder_survives_undumpable_out_dir(tmp_path):
+    target = tmp_path / "not-a-dir"
+    target.write_text("file in the way")
+    rec, journal, reg = _recorder(tmp_path, out_dir=str(target))
+    journal.record("registry_veto", version=1, reason="x")  # must not raise
+    assert reg.value("flight_bundles_total",
+                     labels={"trigger": "guardrail_veto"}) == 0
+    rec.close()
+
+
+def test_crash_handlers_journal_and_bundle_uncaught_exceptions(tmp_path):
+    # no journal arg: the hooks must default to the RECORDER'S (isolated)
+    # journal, not DEFAULT_JOURNAL — else this recorder never sees the
+    # exception record and no crash bundle is written
+    rec, journal, _ = _recorder(tmp_path)
+    uninstall = install_crash_handlers(rec)
+    try:
+        def die():
+            raise ValueError("thread died at 2am")
+
+        t = threading.Thread(target=die, name="scorer")
+        t.start()
+        t.join()
+        recs = journal.tail(kinds=("exception",))
+        assert len(recs) == 1
+        assert recs[0].data["type"] == "ValueError"
+        assert "2am" in recs[0].data["message"]
+        assert "die" in recs[0].data["traceback"]
+        assert recs[0].stream == "scorer"
+        names = [n for n in os.listdir(tmp_path / "bundles")
+                 if n.startswith("bundle-")]
+        assert len(names) == 1 and names[0].endswith("exception")
+        assert (tmp_path / "bundles" / "faulthandler.log").exists() or \
+            os.path.exists(os.path.join(rec.cfg.out_dir, "faulthandler.log"))
+    finally:
+        uninstall()
+        rec.close()
+    # uninstalled: a thread exception no longer journals
+    t = threading.Thread(target=lambda: 1 / 0)
+    t.start()
+    t.join()
+    assert len(journal.tail(kinds=("exception",))) == 1
+
+
+# -- doctor -------------------------------------------------------------------
+
+def _make_bundle(tmp_path):
+    reg = MetricsRegistry(namespace="t")
+    journal = EventJournal(registry=reg)
+    tracer = Tracer(registry=reg)
+    slo = SLOTracker(deadline_sec=0.5, registry=reg, journal=journal)
+    with tracer.span("serve_batch_close", bucket="256n/512e/128s"):
+        time.sleep(0.001)
+    journal.record("config", config_fingerprint="cfg123")
+    journal.record("batch_close", bucket="256n/512e/128s", cause="deadline",
+                   occupancy=3, padding=5, trace_ids=["w-aaa", "w-bbb"])
+    journal.record("admission_drop", stream="s1", window_id=7,
+                   trace_id="w-ccc", reason="backpressure")
+    slo.observe("s1", "w-bbb", 2, stages={"queue": 0.4, "device": 0.3},
+                e2e_sec=0.8)
+    rec = FlightRecorder(
+        FlightConfig(out_dir=str(tmp_path / "bundles")),
+        registry=reg, journal=journal, tracer=tracer, slo=slo,
+        info=lambda: {"lineage": "default", "model_version": "v2"})
+    path = rec.trigger("drop_burst", "3 drops in 1s", context={"drops": 3})
+    rec.close()
+    return path
+
+
+def test_doctor_reconstructs_timeline_offline(tmp_path, capsys):
+    path = _make_bundle(tmp_path)
+    assert path is not None
+    report = format_report(read_bundle(path))
+    # header, timeline with the batch-close record, attribution, SLO state
+    assert "trigger=drop_burst" in report
+    assert "model: lineage=default model_version=v2" in report
+    assert "batch_close" in report and "w-aaa,w-bbb" in report
+    assert "admission_drop" in report and "reason=backpressure" in report
+    assert "serve_batch_close" in report  # span table
+    assert "s1" in report and "w-bbb" in report  # SLO exemplar
+    assert "burn:" in report
+
+    # the CLI surface, from the bundle alone (no live process)
+    from nerrf_tpu.cli import main
+
+    assert main(["doctor", str(path)]) == 0
+    out = capsys.readouterr().out
+    assert "incident timeline" in out and "batch_close" in out
+    assert main(["doctor", str(path), "--json"]) == 0
+    parsed = json.loads(capsys.readouterr().out)
+    assert parsed["manifest"]["trigger"] == "drop_burst"
+    assert any(r["kind"] == "batch_close" for r in parsed["records"])
+
+
+def test_doctor_fails_politely_on_non_bundles(tmp_path, capsys):
+    from nerrf_tpu.cli import main
+
+    assert main(["doctor", str(tmp_path / "absent")]) == 2
+    (tmp_path / "partial").mkdir()
+    (tmp_path / "partial" / "manifest.json").write_text(
+        json.dumps({"trigger": "exception", "reason": "crashed mid-dump"}))
+    # partial bundle: report what exists, exit 1 (evidence incomplete)
+    assert main(["doctor", str(tmp_path / "partial")]) == 1
+    out = capsys.readouterr().out
+    assert "MISSING" in out
+
+
+# -- serve-path integration ---------------------------------------------------
+
+def test_batcher_emits_batch_close_records_with_trace_ids():
+    from nerrf_tpu.serve import MicroBatcher, ServeConfig, WindowRequest
+
+    reg = MetricsRegistry(namespace="t")
+    journal = EventJournal(registry=reg)
+    bucket = (64, 128, 16)
+    cfg = ServeConfig(buckets=(bucket,), batch_size=4, batch_close_sec=0.01)
+    scored_out = []
+    mb = MicroBatcher(
+        score_fn=lambda b: np.full(b["node_mask"].shape, 0.9, np.float64),
+        cfg=cfg, registry=reg, on_scored=scored_out.extend,
+        journal=journal)
+    mb.mark_warm(bucket)
+    sample = {
+        "node_mask": np.ones(bucket[0], bool),
+        "node_type": np.zeros(bucket[0], np.int32),
+        "node_key": np.arange(bucket[0], dtype=np.int64),
+    }
+    t0 = time.perf_counter()
+    for i in range(3):
+        mb.submit(WindowRequest(
+            stream="s0", window_idx=i, lo_ns=0, hi_ns=1, bucket=bucket,
+            sample=dict(sample), t_admit=t0, deadline=t0 + 5.0,
+            trace_id=make_trace_id("s0", i, 0)))
+    assert mb.drain_once(force=True) == 1
+    recs = journal.tail(kinds=("batch_close",))
+    assert len(recs) == 1
+    r = recs[0]
+    assert r.data["occupancy"] == 3 and r.data["padding"] == 1
+    assert r.data["cause"] == "flush" and r.data["streams"] == ["s0"]
+    assert r.data["trace_ids"] == [make_trace_id("s0", i, 0)
+                                   for i in range(3)]
+    # demuxed windows carry the id + the stage stamps the SLO plane needs
+    assert len(scored_out) == 3
+    for s in scored_out:
+        assert s.trace_id and s.t_packed >= t0 and s.t_device >= s.t_packed
+
+
+def test_alert_sink_journals_the_evicted_alert():
+    from nerrf_tpu.serve.alerts import AlertSink, WindowAlert
+
+    reg = MetricsRegistry(namespace="t")
+    journal = EventJournal(registry=reg)
+    sink = AlertSink(slots=2, registry=reg, journal=journal)
+
+    def alert(i):
+        return WindowAlert(stream="s0", window_idx=i, lo_ns=0, hi_ns=1,
+                           max_prob=0.9, hot=[], t_admit=0.0, t_scored=0.1,
+                           late=False, trace_id=f"w-{i}")
+
+    assert sink.emit(alert(0)) and sink.emit(alert(1))
+    assert not sink.emit(alert(2))  # evicts alert 0
+    drops = journal.tail(kinds=("demux_drop",))
+    assert len(drops) == 1
+    assert drops[0].window_id == 0 and drops[0].trace_id == "w-0"
+    assert drops[0].data["reason"] == "sink_full"

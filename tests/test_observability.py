@@ -118,6 +118,57 @@ def test_registry_thread_safety_under_concurrent_render():
     assert "tsafe_ops_total 8000" in reg.render()
 
 
+def test_render_is_a_consistent_snapshot_under_concurrent_writes():
+    """Scrape-vs-write: render() snapshots the registry under the lock and
+    formats OUTSIDE it, so a scrape can never observe a histogram cell
+    mid-update.  Every rendered histogram series must be internally
+    consistent — cumulative bucket counts monotone in ``le``, the +Inf
+    bucket equal to ``_count``, and (for a fixed observed value) the sum
+    exactly value × count — under sustained concurrent observes."""
+    import re
+
+    reg = MetricsRegistry(namespace="snap")
+    stop = threading.Event()
+    errors = []
+    value = 0.01  # lands in every bucket ≥ 0.01 of the ladder below
+
+    def write():
+        try:
+            while not stop.is_set():
+                reg.histogram_observe("lat_seconds", value,
+                                      buckets=(0.005, 0.01, 0.1, 1.0),
+                                      help="lat")
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    def check(text):
+        buckets = [int(m.group(2)) for m in re.finditer(
+            r'snap_lat_seconds_bucket\{le="([^"]+)"\} (\d+)', text)]
+        counts = re.search(r"snap_lat_seconds_count (\d+)", text)
+        sums = re.search(r"snap_lat_seconds_sum ([0-9.e+-]+)", text)
+        if not buckets or counts is None or sums is None:
+            return  # series not registered yet
+        count = int(counts.group(1))
+        assert buckets == sorted(buckets), "bucket counts not cumulative"
+        assert buckets[-1] == count, "+Inf bucket != count (torn cell)"
+        # observing a constant: sum must be exactly value*count — a torn
+        # read (count bumped, sum not yet) breaks this equality
+        assert float(sums.group(1)) == pytest.approx(value * count), \
+            "sum inconsistent with count (mid-update snapshot)"
+
+    writers = [threading.Thread(target=write) for _ in range(4)]
+    for t in writers:
+        t.start()
+    try:
+        for _ in range(200):
+            check(reg.render())
+    finally:
+        stop.set()
+        for t in writers:
+            t.join()
+    assert not errors
+
+
 def test_metrics_server_serves_scrape_and_health():
     reg = MetricsRegistry(namespace="srv")
     reg.counter_inc("pings_total", 7)

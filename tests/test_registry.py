@@ -166,6 +166,24 @@ def test_store_publish_promote_rollback_roundtrip(tmp_path, ckpt_dir):
     assert [v["live"] for v in st["versions"]] == [True, False]
 
 
+def test_store_publish_journals_into_injected_journal(tmp_path, ckpt_dir):
+    """Embedders with an isolated EventJournal (the serve bench, the fake
+    swap service in these tests) must see their own publish records there —
+    not silently in the process-wide DEFAULT_JOURNAL."""
+    from nerrf_tpu.flight.journal import DEFAULT_JOURNAL, EventJournal
+
+    journal = EventJournal(capacity=32)
+    reg = ModelRegistry(tmp_path / "registry", journal=journal)
+    before = DEFAULT_JOURNAL.seq
+    v1 = reg.publish("det", ckpt_dir, source="isolated")
+    recs = journal.tail(kinds=("registry_publish",))
+    assert [(r.data["lineage"], r.data["version"]) for r in recs] == \
+        [("det", v1)]
+    assert recs[0].data["source"] == "isolated"
+    # nothing leaked into the shared ring
+    assert DEFAULT_JOURNAL.seq == before
+
+
 def test_store_publish_gates_bad_checkpoints(tmp_path, ckpt_dir):
     reg = ModelRegistry(tmp_path / "registry")
     # feature-layout drift is rejected at PUBLISH, not discovered at apply
@@ -278,9 +296,16 @@ def _fake_swap_service(cfg, registry):
     svc._params = _leaf_params(0.25)
     svc._model = None
     svc._reg = registry
+    from nerrf_tpu.flight.journal import EventJournal
+    from nerrf_tpu.flight.slo import SLOTracker
     from nerrf_tpu.serve.alerts import AlertSink
 
-    svc.sink = AlertSink(cfg.alert_queue_slots, registry=registry)
+    svc._journal = EventJournal(registry=registry)
+    svc._slo = SLOTracker(cfg.window_deadline_sec, registry=registry,
+                          journal=svc._journal)
+    svc._flight = None
+    svc.sink = AlertSink(cfg.alert_queue_slots, registry=registry,
+                         journal=svc._journal)
     svc._swap_lock = threading.Lock()
     svc._live_version = 1
     svc._shadow = None
@@ -308,7 +333,8 @@ def _fake_swap_service(cfg, registry):
 
     svc._batcher = MicroBatcher(score_fn=score, cfg=cfg, registry=registry,
                                 on_scored=svc._on_scored,
-                                on_failed=svc._on_failed)
+                                on_failed=svc._on_failed,
+                                journal=svc._journal)
     svc._lock = threading.Lock()
     svc._streams = {}
     svc._warm = True

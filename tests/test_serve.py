@@ -54,14 +54,22 @@ def _fake_service(cfg, registry=None, score=None, start=True):
     svc._params = None
     svc._model = None
     svc._reg = registry
+    from nerrf_tpu.flight.journal import EventJournal
+    from nerrf_tpu.flight.slo import SLOTracker
     from nerrf_tpu.serve.alerts import AlertSink
 
-    svc.sink = AlertSink(cfg.alert_queue_slots, registry=registry)
+    svc._journal = EventJournal(registry=registry)
+    svc._slo = SLOTracker(cfg.window_deadline_sec, registry=registry,
+                          journal=svc._journal)
+    svc._flight = None
+    svc.sink = AlertSink(cfg.alert_queue_slots, registry=registry,
+                         journal=svc._journal)
     score = score or (lambda batch:
                       np.full(batch["node_mask"].shape, 0.9, np.float64))
     svc._batcher = MicroBatcher(score_fn=score, cfg=cfg, registry=registry,
                                 on_scored=svc._on_scored,
-                                on_failed=svc._on_failed)
+                                on_failed=svc._on_failed,
+                                journal=svc._journal)
     svc._lock = threading.Lock()
     svc._streams = {}
     svc._warm = True

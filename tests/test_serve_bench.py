@@ -20,6 +20,23 @@ def test_serve_bench_smoke_runs_and_keeps_parity(repo_root):
     assert res["batch"]["occupancy_mean"] >= 1.0
     assert res["window_to_alert_latency_ms"]["p99"] is not None
     assert res["stream_errors"] is None
+    # the SLO plane: per-stream exact trailing percentiles + exemplars
+    slo = res["slo"]
+    assert slo["metric"] == "nerrf_slo_e2e_seconds"
+    for sid in ("s0", "s1"):
+        s = slo["per_stream"][sid]
+        assert s["count"] > 0
+        assert s["p50_ms"] is not None and s["p99_ms"] is not None
+        assert s["exemplar_trace_id"]
+        assert set(s["budget_burn"]) == {"queue", "pack", "device", "demux"}
+    # the flight smoke leg: one rate-limited bundle per injected anomaly,
+    # spike bundle journal-joined to its batch close, doctor-readable
+    flight = res["flight"]
+    assert flight["bundles"] == 2
+    assert sorted(flight["triggers"]) == ["drop_burst", "p99_breach"]
+    assert flight["p99_bundle_has_offending_batch_close"] is True
+    assert flight["doctor_ok"] is True
+    assert flight["suppressed"] > 0  # the rate limit did suppress repeats
 
 
 def test_checked_in_swap_artifact_meets_acceptance(repo_root):
@@ -52,3 +69,12 @@ def test_checked_in_serve_artifact_meets_acceptance(repo_root):
     assert art["parity"]["bit_identical_to_model_detect"] is True
     assert art["window_to_alert_latency_ms"]["p99"] is not None
     assert art["windows_scored"] >= art["streams"]
+    # SLO plane in the artifact of record: per-stream p50/p99 for every
+    # stream, and the flight smoke leg's exactly-one-bundle-per-anomaly
+    per_stream = art["slo"]["per_stream"]
+    assert len(per_stream) >= art["streams"]
+    assert all(s["p50_ms"] is not None and s["p99_ms"] is not None
+               and s["exemplar_trace_id"] for s in per_stream.values())
+    assert art["flight"]["bundles"] == 2
+    assert art["flight"]["doctor_ok"] is True
+    assert art["flight"]["p99_bundle_has_offending_batch_close"] is True

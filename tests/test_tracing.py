@@ -93,6 +93,70 @@ def test_ring_buffer_is_bounded():
     assert recs[-1].args["i"] == 63  # newest kept
 
 
+def test_ring_wraparound_keeps_exact_tail_in_order():
+    """Tail-after-wrap semantics the flight recorder depends on: after the
+    ring wraps, records()/chrome_trace() hold EXACTLY the newest
+    ``capacity`` spans, in recording order, with timestamps intact."""
+    reg = MetricsRegistry()
+    tr = tracing.Tracer(capacity=8, registry=reg)
+    for i in range(27):
+        with tr.span("s", i=i):
+            pass
+    recs = tr.records()
+    assert [r.args["i"] for r in recs] == list(range(19, 27))
+    # timestamps stay monotone across the wrap (no epoch reset)
+    t0s = [r.t0 for r in recs]
+    assert t0s == sorted(t0s)
+    xs = [e for e in tr.chrome_trace()["traceEvents"] if e.get("ph") == "X"]
+    assert [e["args"]["i"] for e in xs] == list(range(19, 27))
+    assert tracing.stage_summary(xs)["s"]["count"] == 8
+    # the dual-written histogram is CUMULATIVE (it never wraps): the span
+    # count diverges from the ring length by design, all 27 recorded
+    assert reg.value(tracing.STAGE_HISTOGRAM, labels={"stage": "s"},
+                     stat="count") == 27
+
+
+def test_wrapped_export_extent_starts_at_the_tail():
+    """After a wrap the exported trace's extent must begin at the OLDEST
+    *kept* span — evicted spans must not stretch wall_clock_us or dilute
+    coverage (the doctor's attribution tables read the export verbatim)."""
+    tr = tracing.Tracer(capacity=4, registry=MetricsRegistry())
+    for i in range(12):
+        with tr.span("s", i=i):
+            time.sleep(0.001)
+    recs = tr.records()
+    xs = [e for e in tr.chrome_trace()["traceEvents"] if e.get("ph") == "X"]
+    lo = min(e["ts"] for e in xs)
+    assert lo == pytest.approx(recs[0].t0 * 1e6, rel=1e-6)
+    assert lo > 0  # strictly after tracer epoch: the head was evicted
+    assert tracing.wall_clock_us(xs) < 12 * 50_000  # tail extent, not 12 spans
+    # sequential non-overlapping spans: the union over the tail's own
+    # extent is dominated by the spans themselves
+    assert tracing.coverage(xs) > 0.5
+
+
+def test_coverage_clamps_spans_to_the_requested_interval():
+    """coverage(lo, hi) on a wrapped-style buffer: spans straddling or
+    outside [lo, hi] contribute only their clamped overlap — the exact
+    semantics the recorder's tail-window attribution relies on."""
+    events = [
+        {"name": "evicted", "ph": "X", "ts": 0.0, "dur": 40.0},
+        {"name": "kept", "ph": "X", "ts": 30.0, "dur": 30.0},   # straddles lo
+        {"name": "kept", "ph": "X", "ts": 70.0, "dur": 20.0},
+        {"name": "kept", "ph": "X", "ts": 95.0, "dur": 20.0},   # straddles hi
+    ]
+    # window [50, 100]: [50,60] ∪ [70,90] ∪ [95,100] = 35 of 50
+    assert tracing.coverage(events, lo_us=50.0, hi_us=100.0) \
+        == pytest.approx(0.7)
+    # a window entirely past every span covers nothing; degenerate → 0
+    assert tracing.coverage(events, lo_us=200.0, hi_us=300.0) == 0.0
+    assert tracing.coverage(events, lo_us=100.0, hi_us=100.0) == 0.0
+    # explicit lo only: hi defaults to the spans' own max end (115), so
+    # the window is [90, 115] and only the last span's [95, 115] counts
+    assert tracing.coverage(events, lo_us=90.0) \
+        == pytest.approx(20.0 / 25.0)
+
+
 def test_train_loop_emits_covering_trace(tmp_path):
     """Acceptance: a 20-step synthetic-corpus run emits a Chrome trace whose
     spans cover ≥95% of the run's wall-clock, and the registry carries the
