@@ -564,6 +564,16 @@ def main() -> None:
                     s.get("breaches", 0) for s in slo_streams.values()),
                 "flight_bundles": (r.get("flight") or {}).get("bundles"),
                 "flight_doctor_ok": (r.get("flight") or {}).get("doctor_ok"),
+                # cold-start plane: the second-boot leg's verdicts (the
+                # full cold/warm split lives in the serve artifact)
+                "compile_cold_boot_s": (r.get("compile") or {}).get(
+                    "cold", {}).get("wall_seconds"),
+                "compile_warm_boot_s": (r.get("compile") or {}).get(
+                    "warm", {}).get("wall_seconds"),
+                "compile_warm_speedup": (r.get("compile") or {}).get(
+                    "warmup_speedup"),
+                "compile_warm_all_cache": (r.get("compile") or {}).get(
+                    "warm_all_cache"),
                 "backend": r.get("backend"),
                 "smoke": r.get("smoke"),
                 "provenance": r.get("provenance"),
@@ -678,6 +688,22 @@ def main() -> None:
             round(device_rollouts_per_sec, 1)
             if device_rollouts_per_sec else None,
         "compile_seconds": compile_seconds or None,
+        # compile as a first-class regression metric (this PR's tentpole):
+        # per-program FRESH figures measured above, plus the serve smoke's
+        # cold-vs-warm boot split through the persistent AOT cache — a
+        # regression in either the compiler or the cache path moves these
+        "compile": {
+            "programs": {name: {"fresh_s": secs}
+                         for name, secs in sorted(compile_seconds.items())},
+            "serve_cold_boot_s":
+                (artifacts.get("serve") or {}).get("compile_cold_boot_s"),
+            "serve_warm_boot_s":
+                (artifacts.get("serve") or {}).get("compile_warm_boot_s"),
+            "serve_warm_speedup":
+                (artifacts.get("serve") or {}).get("compile_warm_speedup"),
+            "serve_warm_all_cache":
+                (artifacts.get("serve") or {}).get("compile_warm_all_cache"),
+        } if compile_seconds or artifacts.get("serve") else None,
         "kernel_path": kernel_path,
         "stream_events_per_sec":
             round(stream_events_per_sec) if stream_events_per_sec else None,

@@ -17,6 +17,15 @@ the same bucket.
 
 Prints ONE JSON line (the artifact) on stdout; exits 1 if parity fails or
 a recompile happened after warmup.
+
+The cold-start leg (this PR's tentpole): the main service boots through a
+COLD persistent compile cache (every bucket compiles fresh and persists),
+then a second service boots from the now-populated cache and must reach
+readiness with every bucket sourced from a deserialized executable —
+gated at ≥5× lower warmup wall than the cold boot, with the warm
+service's single-stream result still bit-identical to model_detect (a
+cached executable changes where the program comes from, never what it
+computes).
 """
 
 from __future__ import annotations
@@ -71,12 +80,27 @@ def run(streams: int = 8, sim_seconds: float = 90.0,
     # run's batch-close records, not another in-process user's
     journal = EventJournal(capacity=8192, registry=registry)
     window_log: list = []
-    svc = OnlineDetectionService(params, model, cfg=cfg, registry=registry,
-                                 window_log=window_log, journal=journal)
+    # cold-start leg: the service boots through an EMPTY persistent cache,
+    # so this warmup is the fresh-compile figure AND it populates the
+    # cache the second-boot leg below deserializes from
+    import tempfile
+
+    from nerrf_tpu.compilecache import CompileCache
+
+    cache_dir = tempfile.mkdtemp(prefix="nerrf-aot-bench-")
+    svc = OnlineDetectionService(
+        params, model, cfg=cfg, registry=registry,
+        window_log=window_log, journal=journal,
+        compile_cache=CompileCache(root=cache_dir, registry=registry,
+                                   journal=journal, log=log))
     t0 = time.perf_counter()
     svc.start(log=log)
-    warmup_wall = round(time.perf_counter() - t0, 1)
-    log(f"[serve-bench] warmup {warmup_wall}s {svc.warmup_seconds}")
+    warmup_wall = round(time.perf_counter() - t0, 2)
+    cold = {"wall_seconds": warmup_wall,
+            "sources": dict(svc.warmup_source),
+            "per_bucket_seconds": dict(svc.warmup_seconds)}
+    log(f"[serve-bench] cold boot {warmup_wall}s {svc.warmup_seconds} "
+        f"{svc.warmup_source}")
 
     # one replay server per stream — every event crosses the real wire
     traces, servers, targets = [], [], []
@@ -182,6 +206,77 @@ def run(streams: int = 8, sim_seconds: float = 90.0,
     finally:
         shutil.rmtree(flight_dir, ignore_errors=True)
 
+    # ---- second-boot leg: warm readiness from the persistent cache ---------
+    # A fresh service (fresh registry/journal — a new pod, same cache
+    # volume) must reach ready with every bucket DESERIALIZED, ≥5× faster
+    # than the cold boot, and still score bit-identically to model_detect.
+    import dataclasses
+
+    warm_reg = MetricsRegistry(namespace="bench2")
+    warm_jrn = EventJournal(capacity=2048, registry=warm_reg)
+    warm_svc = OnlineDetectionService(
+        params, model, cfg=cfg, registry=warm_reg, journal=warm_jrn,
+        compile_cache=CompileCache(root=cache_dir, registry=warm_reg,
+                                   journal=warm_jrn, log=log))
+    t0 = time.perf_counter()
+    warm_svc.start(log=log)
+    warm_wall = round(time.perf_counter() - t0, 2)
+    warm = {"wall_seconds": warm_wall,
+            "sources": dict(warm_svc.warmup_source),
+            "per_bucket_seconds": dict(warm_svc.warmup_seconds)}
+    log(f"[serve-bench] warm boot {warm_wall}s {warm_svc.warmup_seconds} "
+        f"{warm_svc.warmup_source}")
+    try:
+        warm_svc.join("s0")
+        ev = ref_events
+        for i in range(0, len(ev), 256):
+            blk = type(ev)(**{f.name: getattr(ev, f.name)[i:i + 256]
+                              for f in dataclasses.fields(ev)})
+            warm_svc.feed("s0", blk, ref_strings)
+        warm_det = warm_svc.leave("s0", timeout=120.0)
+    finally:
+        warm_svc.stop()
+        shutil.rmtree(cache_dir, ignore_errors=True)
+    warm_parity = (
+        warm_det is not None
+        and warm_det.file_scores == offline.file_scores
+        and warm_det.file_window_scores == offline.file_window_scores
+        and warm_det.proc_scores == offline.proc_scores
+        and warm_det.threshold == offline.threshold)
+    from nerrf_tpu.flight.doctor import compile_provenance
+
+    def _resolutions(jrn):
+        # per-program resolution provenance (fresh-compile vs
+        # cache-deserialize seconds, separate from the donor-batch
+        # execution both legs pay) — same projection the doctor renders.
+        # FIRST record per program wins: the boot-time resolution is what
+        # this leg measures; a later fail-open "live" record (a staged
+        # executable failing at score time, seconds=0.0) must not
+        # overwrite it and deflate the resolution_speedup gate
+        out = {}
+        for c in compile_provenance(jrn.tail()):
+            out.setdefault(c["program"], {"source": c["source"],
+                                          "seconds": c["seconds"]})
+        return out
+
+    cold["resolutions"] = _resolutions(journal)
+    warm["resolutions"] = _resolutions(warm_jrn)
+    res_cold = sum(v["seconds"] or 0.0 for v in cold["resolutions"].values())
+    res_warm = sum(v["seconds"] or 0.0 for v in warm["resolutions"].values())
+    compile_block = {
+        "cache": "persistent content-addressed AOT cache "
+                 "(nerrf_tpu/compilecache, cold → populated → warm boot)",
+        "cold": cold,
+        "warm": warm,
+        "resolution_speedup": round(res_cold / max(res_warm, 1e-9), 1),
+        "warm_all_cache": set(warm["sources"].values()) == {"cache"},
+        "warmup_speedup": round(cold["wall_seconds"]
+                                / max(warm["wall_seconds"], 1e-9), 1),
+        "warm_parity_bit_identical_to_model_detect": bool(warm_parity),
+    }
+    log(f"[serve-bench] warm boot speedup {compile_block['warmup_speedup']}x"
+        f" (parity={warm_parity})")
+
     tag = bucket_tag(tuple(bucket))
     lat_ms = sorted(1e3 * entry[2] for entry in window_log)
 
@@ -229,6 +324,7 @@ def run(streams: int = 8, sim_seconds: float = 90.0,
         # nerrf_slo_e2e_seconds / nerrf_slo_budget_burn_ratio series)
         "slo": {"metric": "nerrf_slo_e2e_seconds", **svc.slo.snapshot()},
         "flight": flight,
+        "compile": compile_block,
         "warmup_seconds": {"wall": warmup_wall, **svc.warmup_seconds},
         "parity": {
             "stream": "s0",
@@ -272,7 +368,21 @@ def main(argv=None) -> int:
           # produced exactly one bundle each, doctor-readable offline
           and result["flight"]["bundles"] == 2
           and result["flight"]["doctor_ok"]
-          and result["flight"]["p99_bundle_has_offending_batch_close"])
+          and result["flight"]["p99_bundle_has_offending_batch_close"]
+          # cold-start acceptance: the second boot deserializes every
+          # bucket (no re-tracing), ≥5× faster than the cold boot, and a
+          # cached executable scores bit-identically to model_detect.
+          # At smoke size the shape-donor execution both boots pay
+          # compresses the WALL ratio, so the smoke run gates the pure
+          # compile-vs-deserialize resolution ratio instead (the same
+          # split test_serve_bench applies); the artifact of record keeps
+          # the full wall-clock gate
+          and result["compile"]["warm_all_cache"]
+          and (result["compile"]["resolution_speedup"] >= 5.0
+               and result["compile"]["warmup_speedup"] >= 1.5
+               if args.smoke
+               else result["compile"]["warmup_speedup"] >= 5.0)
+          and result["compile"]["warm_parity_bit_identical_to_model_detect"])
     return 0 if ok else 1
 
 

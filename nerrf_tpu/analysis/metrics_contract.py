@@ -56,6 +56,14 @@ REQUIRED = (
     "slo_breaches_total",
     "flight_journal_records_total",
     "flight_bundles_total",
+    # the persistent compile cache + warm boot (docs/compile-cache.md;
+    # the serve-bench second-boot leg and the queue pre-flight both gate
+    # on these exact names)
+    "compile_cache_hits_total",
+    "compile_cache_misses_total",
+    "compile_cache_bytes_total",
+    "compile_seconds",
+    "serve_warmup_seconds",
 )
 
 _CALL = re.compile(

@@ -98,15 +98,25 @@ def cmd_train_detector(args) -> int:
     train_cfg = TrainConfig(
         model=model_cfg, batch_size=8, num_steps=args.steps,
         learning_rate=3e-3, warmup_steps=min(30, args.steps // 5))
+    compile_cache = None
+    if not args.no_aot_cache:
+        # persistent AOT cache (docs/compile-cache.md): a repeat run on an
+        # unchanged config deserializes the step executable instead of
+        # paying the BENCH_r04 130 s train_step compile before step 0
+        from nerrf_tpu.compilecache import CompileCache
+
+        compile_cache = CompileCache(root=args.aot_cache, log=_log)
     if args.ckpt_every > 0:
         from nerrf_tpu.train.elastic import train_elastic
 
         res = train_elastic(
             train_ds, eval_ds, train_cfg,
             ckpt_dir=Path(args.model_dir) / "train_state",
-            save_every=args.ckpt_every, log=_log)
+            save_every=args.ckpt_every, log=_log,
+            compile_cache=compile_cache)
     else:
-        res = train_nerrfnet(train_ds, eval_ds, train_cfg, log=_log)
+        res = train_nerrfnet(train_ds, eval_ds, train_cfg, log=_log,
+                             compile_cache=compile_cache)
     _log(f"metrics: edge_auc={res.metrics['edge_auc']:.4f} "
          f"seq_f1={res.metrics['seq_f1']:.4f} ({res.steps_per_sec:.1f} steps/s)")
     save_checkpoint(args.model_dir, res.state.params, model_cfg)
@@ -271,10 +281,27 @@ def cmd_models(args) -> int:
     reg = ModelRegistry(args.registry)
     out: dict
     if args.models_cmd == "publish":
+        if args.aot:
+            # AOT sidecar at publish time: compile + serialize the serve
+            # ladder's executables into <model-dir>/executables/ so every
+            # pod booting this version skips the compile sweep.  Built
+            # BEFORE publish so the sidecar rides the same atomic rename.
+            from nerrf_tpu.utils import (
+                enable_compilation_cache,
+                ensure_backend_or_cpu,
+            )
+
+            enable_compilation_cache()
+            ensure_backend_or_cpu("nerrf-models", timeout_sec=75.0)
+            from nerrf_tpu.compilecache import export_for_checkpoint
+
+            export_for_checkpoint(args.model_dir, log=_log)
         version = reg.publish(args.lineage, args.model_dir,
                               source=args.source)
         out = {"lineage": args.lineage, "published": version,
-               "path": str(reg.version_dir(args.lineage, version))}
+               "path": str(reg.version_dir(args.lineage, version)),
+               "executables": reg.executables_dir(
+                   args.lineage, version) is not None}
         if args.promote:
             out["live"] = reg.promote(args.lineage, version)
     elif args.models_cmd == "list":
@@ -294,6 +321,103 @@ def cmd_models(args) -> int:
         return 2
     print(json.dumps(out, indent=2))
     return 0
+
+
+# --------------------------------------------------------------------------
+def cmd_cache(args) -> int:
+    """The persistent compile cache (docs/compile-cache.md): ``ls`` the
+    entry inventory, ``prune`` to an LRU disk bound, ``verify`` entry
+    integrity, and ``warm`` the serve bucket ladder into the cache so the
+    next boot (pod, bench, queue step) deserializes instead of compiling."""
+    from nerrf_tpu.compilecache import CompileCache, default_cache_dir
+
+    root = args.cache_dir or default_cache_dir()
+    if args.cache_cmd == "warm":
+        # the provisioning sweep: boot a throwaway service through the
+        # cache so every ladder bucket's executable lands on disk — the
+        # CI/queue pre-flight runs this twice and asserts the second
+        # sweep reports source=cache for every bucket
+        from nerrf_tpu.utils import enable_compilation_cache, ensure_backend_or_cpu
+
+        enable_compilation_cache()
+        if not args.no_probe:
+            ensure_backend_or_cpu("nerrf-cache", timeout_sec=75.0)
+        from nerrf_tpu.models import JointConfig, NerrfNet
+        from nerrf_tpu.serve import (
+            OnlineDetectionService,
+            ServeConfig,
+            init_untrained_params,
+        )
+
+        cfg_kwargs = {}
+        if args.buckets:
+            cfg_kwargs["buckets"] = tuple(
+                tuple(int(x) for x in b.split("x")) for b in args.buckets)
+        cfg = ServeConfig(**cfg_kwargs)
+        if args.model_dir:
+            from nerrf_tpu.train.checkpoint import load_checkpoint
+
+            params, model_cfg = load_checkpoint(args.model_dir)
+            model = NerrfNet(model_cfg)
+        else:
+            # cache keys include the param pytree + architecture, so an
+            # untrained sweep warms exactly the untrained-serve programs
+            # (load tests, CI) — warming a real deployment needs its
+            # checkpoint via --model-dir
+            model = NerrfNet(JointConfig().small)
+            params = init_untrained_params(model, cfg)
+        cache = CompileCache(root=root, log=_log)
+        svc = OnlineDetectionService(params, model, cfg=cfg,
+                                     compile_cache=cache)
+        svc.start(log=_log)
+        svc.stop()
+        print(json.dumps({
+            "cache": str(cache.root),
+            "warmup_seconds": svc.warmup_seconds,
+            "source": svc.warmup_source,
+        }, indent=2))
+        if args.expect_cache:
+            # the CI/queue pre-flight contract in one place: the sweep
+            # must have deserialized EVERY ladder bucket (exit 1 on an
+            # empty ladder or any non-cache source)
+            bad = {t: s for t, s in svc.warmup_source.items()
+                   if s != "cache"}
+            if bad or not svc.warmup_source:
+                _log(f"cache warm: --expect-cache FAILED — "
+                     f"{bad or 'empty ladder'}")
+                return 1
+            _log(f"cache warm: {len(svc.warmup_source)} bucket(s) "
+                 f"deserialized (source=cache)")
+        return 0
+    cache = CompileCache(root=root)
+    if args.cache_cmd == "ls":
+        entries = cache.entries()
+        print(json.dumps({
+            "cache": str(cache.root),
+            "entries": entries,
+            "total_bytes": sum(e["bytes"] for e in entries),
+        }, indent=2))
+        return 0
+    if args.cache_cmd == "prune":
+        evicted = cache.prune(max_bytes=args.max_bytes)
+        entries = cache.entries()
+        print(json.dumps({
+            "cache": str(cache.root),
+            "evicted": evicted,
+            "kept": len(entries),
+            "total_bytes": sum(e["bytes"] for e in entries),
+        }, indent=2))
+        return 0
+    if args.cache_cmd == "verify":
+        problems = cache.verify()
+        print(json.dumps({
+            "cache": str(cache.root),
+            "entries": len(cache.entries()),
+            "problems": problems,
+        }, indent=2))
+        return 1 if problems else 0
+    _log(f"unknown cache subcommand {args.cache_cmd!r}")  # pragma: no cover
+    return 2
 
 
 # --------------------------------------------------------------------------
@@ -494,7 +618,20 @@ def cmd_serve_detect(args) -> int:
             tuple(int(x) for x in b.split("x")) for b in args.buckets)
     cfg = ServeConfig(**cfg_kwargs)
 
+    compile_cache = None
+    if not args.no_aot_cache:
+        # persistent compile cache: warm-boot the bucket ladder from
+        # serialized executables (this host's cache volume and/or the
+        # booted version's executables/ sidecar).  Fail-open by contract —
+        # a cold, corrupt, or read-only cache costs a live compile, never
+        # readiness (docs/compile-cache.md).
+        from nerrf_tpu.compilecache import CompileCache
+
+        compile_cache = CompileCache(root=args.aot_cache, log=_log)
+        _log(f"compile cache at {compile_cache.root}")
+
     manager = None
+    executables_dir = None
     if args.registry:
         # registry mode: boot from the lineage's LIVE version and keep a
         # ModelManager polling — retrained checkpoints published into the
@@ -513,8 +650,15 @@ def cmd_serve_detect(args) -> int:
         model = NerrfNet(model_cfg)
         if calib.get("node_threshold") is not None:
             cfg = _dc.replace(cfg, threshold=calib["node_threshold"])
+        # the booted version's AOT sidecar (if it was published with one)
+        # seeds the compile cache: first boot on a fresh pod deserializes
+        # the shipped executables instead of compiling the ladder
+        executables_dir = manager.store.executables_dir(args.lineage,
+                                                        version)
         _log(f"registry boot: {args.lineage}/v{version} LIVE "
-             f"from {args.registry}")
+             f"from {args.registry}"
+             + (" (AOT executables sidecar found)" if executables_dir
+                else ""))
     elif args.model_dir:
         from nerrf_tpu.train.checkpoint import load_calibration, load_checkpoint
 
@@ -529,7 +673,9 @@ def cmd_serve_detect(args) -> int:
         model = NerrfNet(JointConfig().small)
         params = init_untrained_params(model, cfg)
 
-    service = OnlineDetectionService(params, model, cfg=cfg)
+    service = OnlineDetectionService(params, model, cfg=cfg,
+                                     compile_cache=compile_cache,
+                                     executables_dir=executables_dir)
     recorder = None
     uninstall_crash = None
     if args.flight_dir:
@@ -798,6 +944,13 @@ def main(argv=None) -> int:
                         "separate — see `nerrf models`)")
     p.add_argument("--lineage", default="default",
                    help="registry lineage to publish into (with --publish)")
+    p.add_argument("--aot-cache", default=None, metavar="DIR",
+                   help="persistent compile cache root (default: "
+                        "$NERRF_AOT_CACHE_DIR or ~/.cache/nerrf_tpu/aot) — "
+                        "a repeat run on an unchanged config deserializes "
+                        "the train-step executable instead of recompiling")
+    p.add_argument("--no-aot-cache", action="store_true",
+                   help="disable the persistent compile cache")
     p.set_defaults(fn=cmd_train_detector)
 
     p = sub.add_parser("models", help="model lifecycle registry: publish, "
@@ -823,6 +976,11 @@ def main(argv=None) -> int:
     mp.add_argument("--promote", action="store_true",
                     help="also repoint LIVE at the new version immediately "
                         "(skips shadow scoring — prefer guarded promotion)")
+    mp.add_argument("--aot", action="store_true",
+                    help="compile + serialize the serve ladder's "
+                         "executables into the version as an executables/ "
+                         "sidecar — pods booting it skip the warmup "
+                         "compile sweep (docs/compile-cache.md)")
     mp = msub.add_parser("list", help="lineages, versions, LIVE pointers")
     _models_common(mp, lineage_required=False)
     mp = msub.add_parser("promote", help="repoint LIVE at a version "
@@ -955,12 +1113,65 @@ def main(argv=None) -> int:
                         "excepthook+faulthandler) dump self-contained "
                         "diagnostic bundles here, readable offline with "
                         "`nerrf doctor <bundle>`")
+    p.add_argument("--aot-cache", default=None, metavar="DIR",
+                   help="persistent compile cache root (default: "
+                        "$NERRF_AOT_CACHE_DIR or ~/.cache/nerrf_tpu/aot) — "
+                        "warm boots deserialize the bucket ladder from it "
+                        "instead of compiling (docs/compile-cache.md)")
+    p.add_argument("--no-aot-cache", action="store_true",
+                   help="disable the persistent compile cache (every boot "
+                        "compiles the ladder live)")
     p.add_argument("--no-probe", action="store_true",
                    help="skip the bounded accelerator-reachability probe")
     p.add_argument("--trace-out", default=None, metavar="FILE",
                    help="write a Chrome-trace JSON of the serve session's "
                         "host spans on exit")
     p.set_defaults(fn=cmd_serve_detect)
+
+    p = sub.add_parser("cache", help="persistent compile cache: list, "
+                                     "prune, verify, pre-warm")
+    csub = p.add_subparsers(dest="cache_cmd", required=True)
+
+    def _cache_common(cp):
+        cp.add_argument("--cache-dir", default=None, metavar="DIR",
+                        help="cache root (default: $NERRF_AOT_CACHE_DIR or "
+                             "~/.cache/nerrf_tpu/aot)")
+        cp.set_defaults(fn=cmd_cache)
+
+    cp = csub.add_parser("ls", help="entry inventory (program, bytes, "
+                                    "last use), LRU-oldest first")
+    _cache_common(cp)
+    cp = csub.add_parser("prune", help="evict LRU entries past the disk "
+                                       "bound")
+    _cache_common(cp)
+    cp.add_argument("--max-bytes", type=int, default=None,
+                    help="disk bound to prune to (default: the cache's "
+                         "built-in 2 GiB)")
+    cp = csub.add_parser("verify", help="integrity check every entry "
+                                        "(missing files, truncation, "
+                                        "fingerprint mismatch); exit 1 on "
+                                        "problems")
+    _cache_common(cp)
+    cp = csub.add_parser("warm", help="compile the serve bucket ladder "
+                                      "into the cache (provisioning / CI "
+                                      "pre-flight; run twice and the "
+                                      "second sweep must report "
+                                      "source=cache)")
+    _cache_common(cp)
+    cp.add_argument("--model-dir", default=None,
+                    help="checkpoint whose serve programs to warm "
+                         "(default: the untrained small model — cache "
+                         "keys include the params, so warm the model you "
+                         "will serve)")
+    cp.add_argument("--buckets", nargs="*", default=None, metavar="NxExS",
+                    help="capacity-bucket ladder to warm (default: the "
+                         "full serve ladder)")
+    cp.add_argument("--no-probe", action="store_true",
+                    help="skip the bounded accelerator-reachability probe")
+    cp.add_argument("--expect-cache", action="store_true",
+                    help="exit 1 unless EVERY ladder bucket resolved "
+                         "source=cache (the CI/queue pre-flight's second "
+                         "sweep)")
 
     p = sub.add_parser("trace", help="per-stage latency table from a "
                                      "--trace-out Chrome-trace file")

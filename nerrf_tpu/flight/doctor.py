@@ -6,9 +6,14 @@ process, no scrape history.  The report has four sections:
   1. header — trigger, reason, when, environment + model lineage at dump;
   2. incident timeline — the journal tail, one line per record, timed
      relative to the bundle's creation (negative = before the trigger);
-  3. per-stage attribution — `nerrf trace`'s latency table over the
+  3. compile provenance — every ``compile`` journal record (program,
+     cache/fresh/live source, seconds, fingerprint, miss reason), so a
+     slow-boot incident is diagnosable offline: a ladder that compiled
+     fresh when a populated cache volume was mounted is a cache-key or
+     corruption problem, visible right here without chip access;
+  4. per-stage attribution — `nerrf trace`'s latency table over the
      bundled span ring (the same Chrome-trace file loads in Perfetto);
-  4. SLO state — per-stream trailing p50/p99/breaches and budget burn
+  5. SLO state — per-stream trailing p50/p99/breaches and budget burn
      from the manifest's SLO snapshot, exemplar trace IDs included.
 
 Unreadable pieces degrade per-section (a bundle written mid-crash may
@@ -88,6 +93,20 @@ def _compact(v) -> str:
     return s if len(s) <= 60 else s[:57] + "…"
 
 
+def compile_provenance(records: List[JournalRecord]) -> List[dict]:
+    """Every compile-cache resolution in the journal, in order: [{program,
+    source, seconds, fingerprint, reason}, ...].  ``source`` is "cache"
+    (deserialized — no tracing), "fresh" (compiled live, persisted) or
+    "live" (uncached fallback); ``reason`` carries the miss/fallback cause
+    when there was one."""
+    return [{"program": r.data.get("program"),
+             "source": r.data.get("source"),
+             "seconds": r.data.get("seconds"),
+             "fingerprint": r.data.get("fingerprint"),
+             "reason": r.data.get("reason")}
+            for r in records if r.kind == "compile"]
+
+
 def format_report(bundle: dict, tail: Optional[int] = None) -> str:
     man = bundle["manifest"]
     lines: List[str] = []
@@ -126,6 +145,22 @@ def format_report(bundle: dict, tail: Optional[int] = None) -> str:
         lines.append(_fmt_record(rec, t0))
     if not records:
         lines.append("  (no journal records)")
+
+    compiles = compile_provenance(bundle["records"])
+    if compiles:
+        lines.append("")
+        lines.append(f"compile provenance ({len(compiles)} resolutions; "
+                     f"source=cache deserialized, fresh compiled+persisted, "
+                     f"live uncached fallback):")
+        lines.append(f"  {'program':<28} {'source':<7} {'seconds':>8}  "
+                     f"{'fingerprint':<34} reason")
+        for c in compiles:
+            lines.append(
+                f"  {str(c['program'] or '-'):<28} "
+                f"{str(c['source'] or '-'):<7} "
+                f"{_num(c['seconds']):>8}  "
+                f"{str(c['fingerprint'] or '-'):<34} "
+                f"{c['reason'] or '-'}".rstrip())
 
     lines.append("")
     if bundle["events"]:
@@ -180,6 +215,7 @@ def doctor_main(path, tail: Optional[int] = None, as_json: bool = False,
         out(json.dumps({
             "manifest": bundle["manifest"],
             "records": [r.to_dict() for r in bundle["records"]],
+            "compile_provenance": compile_provenance(bundle["records"]),
             "span_events": len(bundle["events"]),
             "missing": bundle["missing"],
         }, indent=2))

@@ -85,19 +85,24 @@ def init_sharded_state(
     return state
 
 
-def make_sharded_train_step(model: NerrfNet, cfg: "TrainConfig", mesh: Mesh):
-    """Jitted train step with explicit in/out shardings over the mesh."""
-    loss_fn = _loop().make_loss_fn(model, cfg)
+def make_sharded_train_step(model: NerrfNet, cfg: "TrainConfig", mesh: Mesh,
+                            compile_cache=None):
+    """Jitted train step with explicit in/out shardings over the mesh.
+
+    ``compile_cache`` routes the step through the persistent AOT cache
+    (`compilecache.StepCache`): the first call on each batch signature
+    resolves — deserializing a prior run's executable when the config,
+    mesh shape, and jax/device identity are unchanged — and later calls
+    dispatch straight to the compiled program.  The mesh axis sizes ride
+    the cache key (sharding changes the emitted collectives, so a (2,1)
+    executable must never serve a (1,2) mesh even at equal device count).
+    """
+    loop = _loop()
+    loss_fn = loop.make_loss_fn(model, cfg)
     b_shard = batch_sharding(mesh)
     r_shard = replicated(mesh)
 
-    @partial(
-        jax.jit,
-        donate_argnums=(0,),
-        in_shardings=(None, b_shard, r_shard),
-        out_shardings=None,
-    )
-    def train_step(state, batch, rng):
+    def step_body(state, batch, rng):
         rng, dropout_rng = jax.random.split(rng)
         (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
             state.params, batch, dropout_rng
@@ -105,7 +110,28 @@ def make_sharded_train_step(model: NerrfNet, cfg: "TrainConfig", mesh: Mesh):
         state = state.apply_gradients(grads=grads)
         return state, loss, aux, rng
 
-    return train_step
+    train_step = jax.jit(
+        step_body,
+        donate_argnums=(0,),
+        in_shardings=(None, b_shard, r_shard),
+        out_shardings=None,
+    )
+
+    if compile_cache is None:
+        return train_step
+    # the cacheable twin: the same flat (params, opt_state, step, batch,
+    # rng) boundary as every other flavor (loop.make_flat_step — the
+    # TrainState treedef can't serialize), with this mesh's shardings
+    # over the flat slots
+    flat_step = loop.make_flat_step(
+        model, cfg, step_body,
+        in_shardings=(None, None, None, b_shard, r_shard),
+        out_shardings=None)
+
+    extra = loop.step_key_extra(cfg, "train_step_sharded")
+    extra["mesh"] = repr(sorted(mesh.shape.items()))
+    return loop.CachedTrainStep(compile_cache, flat_step,
+                                program="train_step_sharded", extra=extra)
 
 
 # --- long-context stream training (dp × sp) ----------------------------------

@@ -281,6 +281,14 @@ class ModelManager:
                     svc.swap_params(
                         params, version,
                         threshold=calibration.get("node_threshold"))
+                # stage the version's AOT sidecar (if published with one):
+                # the running ladder needs no recompile — the swap reuses
+                # the compiled programs by the pytree contract — but any
+                # FUTURE compile (restart, ladder change) now seeds from
+                # the freshest published executables
+                stage = getattr(svc, "stage_executables", None)
+                if stage is not None:
+                    stage(self.store.executables_dir(self.lineage, version))
             except ValueError as e:
                 # pytree-signature mismatch: the checkpoint cannot serve on
                 # the compiled programs — veto so the poll loop does not

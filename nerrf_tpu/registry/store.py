@@ -115,6 +115,13 @@ class ModelRegistry:
         params, cfg = load_checkpoint(path)
         return params, cfg, load_calibration(path), int(version)
 
+    def executables_dir(self, lineage: str, version: int):
+        """The version's AOT ``executables/`` sidecar path, or None when
+        the version was published without one (readers treat absence as a
+        plain cache miss — fail-open)."""
+        d = self.version_dir(lineage, version) / "executables"
+        return d if (d / "manifest.json").is_file() else None
+
     def status(self, lineage: str) -> dict:
         live = self.live(lineage)
         versions = []
@@ -133,18 +140,29 @@ class ModelRegistry:
                 "calibration": meta.get("calibration"),
                 "published_at": meta.get("published_at"),
                 "source": meta.get("published_from"),
+                "executables": self.executables_dir(lineage, v) is not None,
             })
         return {"lineage": lineage, "live": live, "versions": versions}
 
     # -- publish --------------------------------------------------------------
 
     def publish(self, lineage: str, src_dir: str | Path,
-                source: Optional[str] = None) -> int:
+                source: Optional[str] = None,
+                executables: Optional[str | Path] = None) -> int:
         """Copy a checkpoint directory into the lineage as the next
         immutable version and return its number.  The schema/feature-layout
         gates run HERE — a checkpoint the current code could not load is
         rejected at publish, never discovered at apply time by a serving
-        pod.  Does NOT touch LIVE (promotion is a separate, guarded step)."""
+        pod.  Does NOT touch LIVE (promotion is a separate, guarded step).
+
+        ``executables`` is an optional AOT sidecar (the directory
+        `compilecache.export_executables` wrote): it is copied in as
+        ``executables/`` next to ``params/`` inside the same atomic
+        rename, so a serve pod booting this version can seed its compile
+        cache from serialized executables and skip the bucket-ladder
+        compile sweep entirely.  A source checkpoint that already carries
+        its own ``executables/`` (export_for_checkpoint writes in place)
+        rides along without this argument."""
         src = Path(src_dir).absolute()
         validate_checkpoint_dir(src)
         import errno
@@ -154,12 +172,25 @@ class ModelRegistry:
         tmp = ldir / f".publish.tmp-{os.getpid()}-{time.monotonic_ns()}"
         try:
             shutil.copytree(src, tmp)
+            if executables is not None:
+                exe = Path(executables).absolute()
+                if not (exe / "manifest.json").is_file():
+                    raise FileNotFoundError(
+                        f"not an executables sidecar: {exe} has no "
+                        f"manifest.json (run compilecache.export_executables "
+                        f"first)")
+                dst = tmp / "executables"
+                if dst.exists():  # explicit sidecar wins over a stale copy
+                    shutil.rmtree(dst)
+                shutil.copytree(exe, dst)
             # stamp provenance into the *copy*'s sidecar (the source
             # checkpoint stays untouched)
             sidecar = tmp / "model_config.json"
             meta = json.loads(sidecar.read_text())
             meta["published_at"] = time.time()
             meta["published_from"] = source or str(src)
+            if (tmp / "executables" / "manifest.json").is_file():
+                meta["executables"] = "executables/"
             sidecar.write_text(json.dumps(meta, indent=2))
             while True:
                 version = (max(self.versions(lineage), default=0)) + 1

@@ -99,6 +99,8 @@ class OnlineDetectionService:
         window_log: Optional[list] = None,
         journal=None,
         flight=None,
+        compile_cache=None,
+        executables_dir=None,
     ) -> None:
         if registry is None:
             from nerrf_tpu.observability import DEFAULT_REGISTRY
@@ -108,6 +110,19 @@ class OnlineDetectionService:
         self._params = params
         self._model = model
         self._eval_fn = make_eval_fn(model)
+        # persistent compile cache (nerrf_tpu/compilecache): warmup resolves
+        # each bucket program through it — a populated cache (or a published
+        # version's executables/ sidecar, ``executables_dir``) boots the
+        # ladder from serialized executables with zero tracing.  None keeps
+        # the live-jit-only path (tests, embedders without a cache volume).
+        self._cache = compile_cache
+        if self._cache is not None and executables_dir is not None:
+            self._cache.add_seed_dir(executables_dir)
+        # per-batch-signature (executable, bucket tag) pairs, staged at
+        # warmup and read by the scorer thread; a failing executable is
+        # dropped at score time (fail-open → the live jit path), so
+        # entries only ever disappear
+        self._compiled: Dict[tuple, tuple] = {}
         self._reg = registry
         self._journal = journal if journal is not None else DEFAULT_JOURNAL
         # the SLO plane: per-stream e2e histograms + per-stage budget burn
@@ -130,6 +145,10 @@ class OnlineDetectionService:
         self._warm = False
         self._admission_open = False
         self.warmup_seconds: Dict[str, float] = {}
+        # how each bucket program was obtained at warmup: "cache" (AOT
+        # deserialized — no tracing), "fresh" (compiled live, persisted),
+        # or "live" (plain jit, no cache) — the warm-boot acceptance gate
+        self.warmup_source: Dict[str, str] = {}
         # model lifecycle state (nerrf_tpu/registry): the live param
         # pointer is swapped atomically under _swap_lock between batch
         # closes; a staged shadow candidate scores the same batches
@@ -165,11 +184,41 @@ class OnlineDetectionService:
             params = self._params
             version = self._live_version
             shadow = self._shadow
-        out = jax.device_get(self._eval_fn(params, batch))
+        out = jax.device_get(self._run_eval(params, batch))
         probs = 1.0 / (1.0 + np.exp(-out["node_logit"]))
         if shadow is not None:
             self._shadow_score(shadow, batch, probs)
         return probs, version
+
+    def _run_eval(self, params, batch):
+        """One eval launch: the bucket's staged AOT executable when there
+        is one, the live jit function otherwise.  Both run the identical
+        program (same HLO, same compile options — the serialized
+        executable IS a compile of the jit function), so the parity
+        contract survives the cache.  Fail-open: an executable that raises
+        is dropped and the batch re-scored through jit — an executable
+        problem costs one compile, never a window."""
+        sig = _batch_signature(batch)
+        staged = self._compiled.get(sig)
+        if staged is not None:
+            exe, tag = staged
+            try:
+                return exe(params, batch)
+            except Exception as e:  # noqa: BLE001 — fail-open to live jit
+                self._compiled.pop(sig, None)
+                program = f"serve_eval[{tag}]"
+                self._journal.record(
+                    "compile", program=program, source="live",
+                    seconds=0.0,
+                    reason=f"staged executable failed at call time: "
+                           f"{type(e).__name__}: {e}")
+                self._reg.counter_inc(
+                    "compile_cache_misses_total",
+                    labels={"program": program,
+                            "reason": "call_failed"},
+                    help="cache lookups that fell back to a live compile, "
+                         "by miss cause")
+        return self._eval_fn(params, batch)
 
     def _shadow_score(self, shadow, batch, live_probs) -> None:
         """Score the staged candidate against the SAME packed batch the
@@ -183,7 +232,7 @@ class OnlineDetectionService:
             with trace_span("registry_shadow_score", device=True,
                             version=s_version,
                             windows=int(live_probs.shape[0])):
-                s_out = jax.device_get(self._eval_fn(s_params, batch))
+                s_out = jax.device_get(self._run_eval(s_params, batch))
             s_probs = 1.0 / (1.0 + np.exp(-s_out["node_logit"]))
             if self._manager is None:
                 return
@@ -283,10 +332,17 @@ class OnlineDetectionService:
             self._shadow = None
 
     def _warmup(self, log=None) -> None:
-        """Compile the eval program for every configured bucket (the
+        """Ready the eval program for every configured bucket (the
         detector-side warmup_detector sweep, through the serve path's own
-        shape authority so the jit cache is keyed exactly as admission will
-        key it).  Readiness (`ready`) gates on completion."""
+        shape authority so programs are keyed exactly as admission will
+        key them).  Readiness (`ready`) gates on completion.
+
+        With a compile cache, each bucket resolves through it first: a hit
+        deserializes a shipped/persisted executable — no tracing, no XLA,
+        readiness in seconds; a miss compiles live and persists for the
+        next boot.  Every staged program then scores the shape-donor batch
+        once, which both proves the executable runs on this device and
+        keeps the no-cache jit path's warmup semantics unchanged."""
         tiny = _tiny_trace("serve-warmup")
         for bucket in self.cfg.buckets:
             ds_cfg = self.cfg.dataset_config(bucket)
@@ -299,12 +355,47 @@ class OnlineDetectionService:
                 for k, v in s0.items()}
             tag = bucket_tag(bucket)
             t0 = time.perf_counter()
+            self.warmup_source[tag] = self._stage_program(tag, batch)
             self._score_fn(batch)
             self.warmup_seconds[tag] = round(time.perf_counter() - t0, 2)
+            self._reg.gauge_set(
+                "serve_warmup_seconds", self.warmup_seconds[tag],
+                labels={"bucket": tag},
+                help="seconds to ready one bucket's eval program at boot "
+                     "(compile or cache-deserialize + first execution)")
             self._batcher.mark_warm(bucket)
             if log:
                 log(f"serve bucket {tag} warm "
-                    f"({self.warmup_seconds[tag]}s)")
+                    f"({self.warmup_seconds[tag]}s, "
+                    f"{self.warmup_source[tag]})")
+
+    def _stage_program(self, tag: str, batch: Dict[str, np.ndarray]) -> str:
+        """Resolve one bucket's eval program through the compile cache and
+        stage it for the scorer thread.  Returns the provenance ("cache" /
+        "fresh" / "live"); without a cache the live jit path stays as-is."""
+        if self._cache is None:
+            return "live"
+        from nerrf_tpu.compilecache import serve_program_key
+
+        fn, info = self._cache.load_or_compile(
+            self._eval_fn, (self._params, batch),
+            program=f"serve_eval[{tag}]",
+            extra=serve_program_key(self.model_config, tag))
+        if fn is not self._eval_fn:
+            self._compiled[_batch_signature(batch)] = (fn, tag)
+        return info.source
+
+    def stage_executables(self, exe_dir) -> None:
+        """Register a published version's ``executables/`` sidecar as a
+        cache seed (the ModelManager calls this on swap).  The running
+        ladder needs nothing restaged — a hot-swap reuses the compiled
+        programs by the pytree-signature contract — but future misses
+        (restart, ladder change) now resolve from the freshest sidecar.
+        Tolerates cache-less services (getattr: embedders build skeleton
+        services without __init__ — staging is strictly best-effort)."""
+        cache = getattr(self, "_cache", None)
+        if cache is not None and exe_dir is not None:
+            cache.add_seed_dir(exe_dir)
 
     # -- lifecycle ------------------------------------------------------------
 
@@ -326,7 +417,8 @@ class OnlineDetectionService:
         self._batcher.start()
         self._admission_open = True
         self._journal.record("readiness", ready=True,
-                             warmup_seconds=dict(self.warmup_seconds))
+                             warmup_seconds=dict(self.warmup_seconds),
+                             warmup_source=dict(self.warmup_source))
         return self
 
     def ready(self):
@@ -675,6 +767,15 @@ class OnlineDetectionService:
                                   threshold=self.cfg.threshold,
                                   detector=detector,
                                   ino_path=ino_path)
+
+
+def _batch_signature(batch: Dict[str, np.ndarray]) -> tuple:
+    """The scorer-side lookup key for a staged AOT executable: the padded
+    batch's (name, shape, dtype) set — exactly what distinguishes one
+    bucket's program from another's at call time."""
+    return tuple(sorted(
+        (k, tuple(v.shape), str(getattr(v, "dtype", type(v).__name__)))
+        for k, v in batch.items()))
 
 
 def _check_swap_compatible(current, incoming) -> None:

@@ -145,9 +145,15 @@ def train_elastic(
     save_every: int = 50,
     fault=None,
     log=None,
+    compile_cache=None,
 ) -> TrainResult:
     """Run (or resume) training to `cfg.num_steps` with periodic full-state
-    checkpoints.  Restartable at any point; deterministic across restarts."""
+    checkpoints.  Restartable at any point; deterministic across restarts.
+
+    ``compile_cache`` (a `compilecache.CompileCache`) matters most here:
+    every supervisor restart re-pays the step compile before resuming, so
+    an elastic run with the persistent cache resumes stepping in the time
+    it takes to deserialize one executable."""
     cfg = cfg or TrainConfig()
     ckpt_dir = Path(ckpt_dir)
     ckpt_dir.mkdir(parents=True, exist_ok=True)
@@ -168,6 +174,11 @@ def train_elastic(
     resident = _fits_resident(train_ds.arrays)
     train_step = (make_train_step_resident(model, cfg, train_ds.arrays)
                   if resident else make_train_step(model, cfg))
+    if compile_cache is not None:
+        from nerrf_tpu.train.loop import cache_train_step
+
+        train_step = cache_train_step(compile_cache, train_step, model, cfg,
+                                      "train_step_resident")
     n = len(train_ds)
     history = []
     t_start = None

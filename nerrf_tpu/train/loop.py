@@ -122,6 +122,102 @@ def make_train_step(model: NerrfNet, cfg: TrainConfig):
     return train_step
 
 
+def make_flat_step(model: NerrfNet, cfg: TrainConfig, body, **jit_kwargs):
+    """Jit ``body(state, *rest) -> (state, loss, aux, rng)`` behind a
+    SERIALIZABLE pytree boundary: (params, opt_state, step, *rest) in,
+    ((params, opt_state, step), loss, aux, rng) out.
+
+    The persistent compile cache (nerrf_tpu/compilecache) serializes an
+    executable's in/out treedefs next to the XLA payload, and a reloaded
+    executable only accepts calls whose arg treedef compares EQUAL to the
+    stored one.  `TrainState`'s treedef carries ``apply_fn``/``tx`` as
+    static aux data — closures that neither pickle nor compare equal
+    across processes — so a TrainState-shaped program can never be AOT-
+    cached.  Flattening the boundary to plain dicts/namedtuples of arrays
+    (optax states are module-level NamedTuples) makes the treedefs both
+    picklable and process-stable; the TrainState wrapper is rebuilt
+    INSIDE the traced function, where it costs nothing.
+
+    ``jit_kwargs`` extend the jit decoration (the sharded twin in
+    parallel/train.py passes in/out_shardings over the FLAT slots) so the
+    boundary contract lives in exactly one body."""
+    tx = make_tx(cfg)
+
+    @partial(jax.jit, donate_argnums=(0, 1), **jit_kwargs)
+    def flat_step(params, opt_state, step_no, *rest):
+        state = train_state.TrainState(
+            step=step_no, apply_fn=model.apply, params=params, tx=tx,
+            opt_state=opt_state)
+        state, loss, aux, rng = body(state, *rest)
+        return (state.params, state.opt_state, state.step), loss, aux, rng
+
+    return flat_step
+
+
+class CachedTrainStep:
+    """A TrainState-in/TrainState-out train step resolved through the
+    persistent compile cache.
+
+    Wraps a `make_flat_step` program in a `compilecache.StepCache` (one
+    resolution per argument-shape signature: deserialize on a cache hit,
+    compile+persist on a miss, live jit on total failure) and converts
+    state↔flat at the boundary — callers keep the exact signature of the
+    jit step they replaced.  The returned state is ``state.replace(...)``
+    of the caller's own TrainState, so the live ``apply_fn``/``tx``
+    objects flow through untouched (nothing reconstructed from a cache
+    entry ever leaks into caller state)."""
+
+    def __init__(self, cache, flat_fn, program: str, extra=None,
+                 tail: tuple = ()) -> None:
+        from nerrf_tpu.compilecache import StepCache
+
+        self._sc = StepCache(cache, flat_fn, program=program, extra=extra,
+                             tail=tail)
+
+    @property
+    def infos(self):
+        """Every resolution's CompileInfo (provenance for benches/tests)."""
+        return self._sc.infos
+
+    def __call__(self, state, *rest):
+        # a fresh TrainState carries step as a Python int; the program's
+        # output carries it as an int32 array — pin the boundary dtype so
+        # step 0 and step N resolve to the SAME executable signature
+        step_no = jnp.asarray(state.step, jnp.int32)
+        (params, opt_state, step_no), loss, aux, rng = self._sc(
+            state.params, state.opt_state, step_no, *rest)
+        return (state.replace(params=params, opt_state=opt_state,
+                              step=step_no), loss, aux, rng)
+
+
+def make_flat_train_step(model: NerrfNet, cfg: TrainConfig):
+    """The cacheable twin of `make_train_step`: same grad/update body, flat
+    (params, opt_state, step, batch, rng) boundary — see `make_flat_step`."""
+    loss_fn = make_loss_fn(model, cfg)
+    return make_flat_step(model, cfg, partial(_step_body, loss_fn))
+
+
+def cache_train_step(compile_cache, train_step, model: NerrfNet,
+                     cfg: TrainConfig, resident_flavor: str):
+    """Route a (batch, rng)-shaped train step through the persistent
+    compile cache — the ONE wiring point for every loop that swaps its
+    jitted step for a `CachedTrainStep` (a key-material change here
+    changes every flavor at once, instead of silently missing one).
+    Resident steps expose their cacheable twin as ``flat_jit_fn`` with the
+    device-resident arrays as the bound ``tail``; plain steps get a fresh
+    `make_flat_train_step`.  ``resident_flavor`` names the resident
+    program in the cache key (scheduled vs by-idx lower different HLO)."""
+    flat = getattr(train_step, "flat_jit_fn", None)
+    if flat is not None:
+        return CachedTrainStep(
+            compile_cache, flat, program="train_step",
+            extra=step_key_extra(cfg, resident_flavor),
+            tail=train_step.tail)
+    return CachedTrainStep(
+        compile_cache, make_flat_train_step(model, cfg),
+        program="train_step", extra=step_key_extra(cfg, "train_step"))
+
+
 def make_train_step_resident(model: NerrfNet, cfg: TrainConfig, arrays):
     """Train step over an HBM-resident dataset: the full window arrays are
     device_put once and passed as jit *parameters* (closure capture would
@@ -216,19 +312,31 @@ def _make_resident_steps(model: NerrfNet, cfg: TrainConfig, arrays):
     def step_by_idx(state: train_state.TrainState, idx, rng, data):
         return gathered_step(state, idx, rng, data)
 
-    @partial(jax.jit, donate_argnums=(0,))
-    def step_by_schedule(state: train_state.TrainState, rng, data, sched):
+    def scheduled_body(state, rng, data, sched):
         idx = jnp.take(sched, state.step % sched.shape[0], axis=0)
         return gathered_step(state, idx, rng, data)
 
+    step_by_schedule = jax.jit(scheduled_body, donate_argnums=(0,))
+
     def resident(state, idx, rng):
         return step_by_idx(state, idx, rng, dev)
+
+    # the cacheable twin (see make_flat_step): dev stays a jit *parameter*
+    # there too, bound as the StepCache tail
+    resident.flat_jit_fn = make_flat_step(model, cfg, gathered_step)
+    resident.tail = (dev,)
+    flat_by_schedule = make_flat_step(model, cfg, scheduled_body)
 
     def make_scheduled(idx_table):
         table = jax.device_put(np.asarray(idx_table, np.int32))
         fn = lambda state, rng: step_by_schedule(state, rng, dev, table)
         # expose AOT lowering so the bench can cost-analyze the real HLO
         fn.lower = lambda state, rng: step_by_schedule.lower(state, rng, dev, table)
+        # ... and the flat cacheable twin + bound tail so train_nerrfnet
+        # can route the step through the persistent compile cache
+        # (CachedTrainStep — dev/table stay jit *parameters* there too)
+        fn.flat_jit_fn = flat_by_schedule
+        fn.tail = (dev, table)
         return fn
 
     def make_super(idx_table, steps_per_call):
@@ -258,6 +366,25 @@ def _make_resident_steps(model: NerrfNet, cfg: TrainConfig, arrays):
         return fn
 
     return resident, make_scheduled, make_super
+
+
+def step_key_extra(cfg: TrainConfig, flavor: str) -> dict:
+    """Caller-side compile-cache key material for a train-step program: the
+    full training config (model architecture AND optimizer/loss
+    hyperparameters — learning-rate schedule, loss weights, pos_weight all
+    constant-fold into the HLO), the kernel switchboard routing, and the
+    donation spec — every axis beyond the argument avals that changes the
+    lowered program.  Conservative by construction: a config change that
+    would NOT change the HLO still misses (one extra compile), but a stale
+    executable can never be reused."""
+    from nerrf_tpu.ops.segment import active_impls
+
+    return {
+        "kind": flavor,
+        "train_cfg": repr(cfg),
+        "ops": repr(sorted(active_impls().items())),
+        "donate": "(params,opt_state)",
+    }
 
 
 def make_idx_schedule(n: int, cfg: TrainConfig) -> np.ndarray:
@@ -423,7 +550,13 @@ def train_nerrfnet(
     eval_ds: Optional[WindowDataset] = None,
     cfg: Optional[TrainConfig] = None,
     log=None,
+    compile_cache=None,
 ) -> TrainResult:
+    """``compile_cache`` (a `compilecache.CompileCache`) routes the jitted
+    train step through the persistent AOT cache: a repeat run on an
+    unchanged config deserializes the step executable instead of paying
+    the flagship compile (130 s at BENCH_r04 shapes) before step 0.
+    Fail-open — any cache problem falls back to the live jit path."""
     cfg = cfg or TrainConfig()
     model = NerrfNet(cfg.model)
     # config+model fingerprints into the flight journal: a run's identity
@@ -461,6 +594,9 @@ def train_nerrfnet(
         else:
             train_step = make_train_step(model, cfg)
         eval_fn = make_eval_fn(model)
+    if compile_cache is not None:
+        train_step = cache_train_step(compile_cache, train_step, model, cfg,
+                                      "train_step_scheduled")
 
     order_rng = np.random.default_rng(cfg.seed)
     history = []
@@ -569,6 +705,7 @@ def train_sharded_stream(
     ckpt_dir=None,
     save_every: int = 0,
     upload_chunk_bytes: int = 64 << 20,
+    compile_cache=None,
 ) -> TrainResult:
     """100 h-scale training: rotate disk shards through HBM, double-buffered.
 
@@ -606,8 +743,7 @@ def train_sharded_stream(
     model = NerrfNet(cfg.model)
     loss_fn = make_loss_fn(model, cfg)
 
-    @partial(jax.jit, donate_argnums=(0,))
-    def step_by_idx(state, idx, rng, data):
+    def stream_body(state, idx, rng, data):
         batch = {k: jnp.take(v, idx, axis=0) for k, v in data.items()}
         # f16 is a storage/transfer format only — compute sees f32
         batch = {
@@ -615,6 +751,17 @@ def train_sharded_stream(
             for k, v in batch.items()
         }
         return _step_body(loss_fn, state, batch, rng)
+
+    step_by_idx = jax.jit(stream_body, donate_argnums=(0,))
+
+    if compile_cache is not None:
+        # persistent AOT cache: each distinct shard shape resolves once
+        # (deserialize on a repeat run — the 56.6 s BENCH_r04 stream_step
+        # compile drops to a disk read), later steps dispatch directly
+        step_by_idx = CachedTrainStep(
+            compile_cache, make_flat_step(model, cfg, stream_body),
+            program="stream_step",
+            extra=step_key_extra(cfg, "stream_step"))
 
     # -- shard pipeline: disk → host queue → async device upload -------------
     host_q: "queue_mod.Queue" = queue_mod.Queue(maxsize=1)

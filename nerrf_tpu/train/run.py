@@ -35,7 +35,8 @@ def run_experiment(name_or_path: str, out_dir: str | Path,
                    ckpt_every: int = 0, sharded: bool | None = None,
                    calibrate: bool = True,
                    publish_to: str | None = None,
-                   lineage: str = "default") -> dict:
+                   lineage: str = "default",
+                   compile_cache=None) -> dict:
     import dataclasses
 
     import jax
@@ -84,7 +85,7 @@ def run_experiment(name_or_path: str, out_dir: str | Path,
             res = train_sharded_stream(
                 sc, cfg, eval_ds=eval_ds, log=_log,
                 ckpt_dir=(out / "train_state") if ckpt_every > 0 else None,
-                save_every=ckpt_every)
+                save_every=ckpt_every, compile_cache=compile_cache)
             metrics, steps_per_sec, params = (
                 res.metrics, res.steps_per_sec, res.state.params)
             corpus_extra = {
@@ -123,7 +124,8 @@ def run_experiment(name_or_path: str, out_dir: str | Path,
         mesh = make_mesh(exp.mesh)
         model = NerrfNet(cfg.model)
         state = init_sharded_state(model, cfg, train_ds.arrays, mesh)
-        step = make_sharded_train_step(model, cfg, mesh)
+        step = make_sharded_train_step(model, cfg, mesh,
+                                       compile_cache=compile_cache)
         import numpy as np
 
         rng = jax.random.PRNGKey(cfg.seed)
@@ -159,13 +161,15 @@ def run_experiment(name_or_path: str, out_dir: str | Path,
 
         res = train_elastic(train_ds, eval_ds, cfg,
                             ckpt_dir=out / "train_state",
-                            save_every=ckpt_every, log=_log)
+                            save_every=ckpt_every, log=_log,
+                            compile_cache=compile_cache)
         metrics, steps_per_sec, params = (
             res.metrics, res.steps_per_sec, res.state.params)
     else:
         from nerrf_tpu.train.loop import train_nerrfnet
 
-        res = train_nerrfnet(train_ds, eval_ds, cfg, log=_log)
+        res = train_nerrfnet(train_ds, eval_ds, cfg, log=_log,
+                             compile_cache=compile_cache)
         metrics, steps_per_sec, params = (
             res.metrics, res.steps_per_sec, res.state.params)
 
@@ -266,6 +270,14 @@ def main(argv=None) -> int:
                          "registry after training (see docs/model-lifecycle.md)")
     ap.add_argument("--lineage", default="default",
                     help="registry lineage to publish into (with --publish)")
+    ap.add_argument("--aot-cache", default=None, metavar="DIR",
+                    help="persistent compile cache root (default: "
+                         "$NERRF_AOT_CACHE_DIR or ~/.cache/nerrf_tpu/aot) — "
+                         "repeat runs on an unchanged config deserialize "
+                         "the train-step executable instead of recompiling")
+    ap.add_argument("--no-aot-cache", action="store_true",
+                    help="disable the persistent compile cache (every run "
+                         "pays the full train-step compile)")
     args = ap.parse_args(argv)
     # Multi-host: join the cluster BEFORE any backend use.  Set
     # NERRF_COORDINATOR/NERRF_NUM_PROCESSES/NERRF_PROCESS_ID per process
@@ -307,9 +319,16 @@ def main(argv=None) -> int:
 
         _log(f"distributed: process {jax.process_index()}/"
              f"{jax.process_count()}, {jax.device_count()} global devices")
+    compile_cache = None
+    if not args.no_aot_cache:
+        from nerrf_tpu.compilecache import CompileCache
+
+        compile_cache = CompileCache(root=args.aot_cache, log=_log)
+        _log(f"compile cache at {compile_cache.root}")
     report = run_experiment(args.experiment, args.out, args.steps,
                             args.ckpt_every, publish_to=args.publish,
-                            lineage=args.lineage)
+                            lineage=args.lineage,
+                            compile_cache=compile_cache)
     return 0 if all(report["gates"].values()) else 1
 
 

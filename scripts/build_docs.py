@@ -37,7 +37,8 @@ DOCS = REPO / "docs"
 # page order for the sidebar (index first, then the operator's journey)
 ORDER = ["index", "quick-start", "architecture", "models", "kernel-paths",
          "planner", "rollback", "ingest", "scaling", "configuration",
-         "operations", "flight-recorder", "static-analysis", "benchmarks"]
+         "serving", "model-lifecycle", "compile-cache", "operations",
+         "flight-recorder", "static-analysis", "benchmarks"]
 
 _CSS = """
 :root { --fg:#1a1f24; --bg:#ffffff; --accent:#0b63c5; --muted:#5a6572;

@@ -47,6 +47,18 @@ cd "$(dirname "$0")/.."
 # instead of surfacing as a race/recompile mid-stream
 python scripts/nerrflint.py
 
+# pre-flight: the persistent compile cache must round-trip — warm one
+# serve bucket into a scratch cache (fresh compile, persisted), then
+# assert the second sweep DESERIALIZES it (source=cache for every
+# bucket).  A cache-key-stability or executable-serialization regression
+# fails here in seconds instead of costing every pod its cold boot back
+# (docs/compile-cache.md).
+NERRF_AOT_CACHE_DIR="$WORK/aot" python -m nerrf_tpu.cli cache warm \
+    --no-probe --buckets 64x128x32 > "$WORK/cache_cold.json"
+NERRF_AOT_CACHE_DIR="$WORK/aot" python -m nerrf_tpu.cli cache warm \
+    --no-probe --buckets 64x128x32 --expect-cache > "$WORK/cache_warm.json"
+echo "e2e: compile cache round-trips (second sweep source=cache)"
+
 if [ "$MODE" = "live" ]; then
     make -C native build/nerrf-trackerd >/dev/null
     rc=0

@@ -57,6 +57,23 @@ wait_for_tpu() {
   log "TPU is up (fresh compile path verified after $n failed probes)"
 }
 wait_for_tpu
+# pre-flight: compile-cache round-trip ON THE CHIP — warm the serve
+# ladder once into the persistent AOT cache (the one cold sweep this
+# host will ever pay), then assert the second sweep reports
+# source=cache for every ladder bucket.  A key-stability or
+# executable-serialization regression on this backend fails here, before
+# hours of queue work re-pay compiles that should be disk reads
+# (docs/compile-cache.md).
+log "pre-flight: compile-cache warm sweep (serve ladder, cold)"
+timeout 2400 python -m nerrf_tpu.cli cache warm \
+  > /tmp/cache_cold.json 2>> /tmp/tpu_queue.log
+if ! timeout 600 python -m nerrf_tpu.cli cache warm --expect-cache \
+  > /tmp/cache_warm.json 2>> /tmp/tpu_queue.log
+then
+  log "PRE-FLIGHT FAIL: compile-cache second sweep not source=cache for every bucket (/tmp/cache_warm.json)"
+  exit 1
+fi
+log "pre-flight: compile cache round-trips (second sweep source=cache)"
 # require the regenerated zero-drop corpus with the stealth variants:
 # training the flagship on an older corpus would leave it blind to exactly
 # the scenarios the adversarial eval measures (VERDICT r3 item 3)

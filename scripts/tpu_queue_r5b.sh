@@ -42,6 +42,18 @@ wait_for_tpu() {
 }
 log "1/4 bench.py of record (MFU + 4096-bucket leg)"
 wait_for_tpu
+# same compile-cache pre-flight as tpu_queue.sh: one cold warm sweep,
+# then the second must deserialize every ladder bucket (fail fast before
+# the tunnel window is spent on redundant compiles)
+timeout 2400 python -m nerrf_tpu.cli cache warm \
+  > /tmp/cache_cold.json 2>> /tmp/tpu_queue.log
+if ! timeout 600 python -m nerrf_tpu.cli cache warm --expect-cache \
+  > /tmp/cache_warm.json 2>> /tmp/tpu_queue.log
+then
+  log "PRE-FLIGHT FAIL: compile-cache second sweep not source=cache for every bucket (/tmp/cache_warm.json)"
+  exit 1
+fi
+log "pre-flight: compile cache round-trips (second sweep source=cache)"
 timeout 3600 python bench.py > /tmp/bench_smoke.json 2> /tmp/bench_smoke.log
 log "bench rc=$?"
 log "2/4 chip-gated compiled-kernel test"

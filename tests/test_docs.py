@@ -25,7 +25,7 @@ def test_referenced_cli_commands_exist(repo_root):
     referenced = set(re.findall(r"nerrf_tpu\.cli (\w[\w-]*)", text))
     parser_cmds = {"simulate", "train-detector", "undo", "status", "serve",
                    "serve-detect", "ingest", "trace", "warmup", "doctor",
-                   "models", "lint"}
+                   "models", "lint", "cache"}
     assert referenced <= parser_cmds
     # and the parser really accepts them
     for cmd in parser_cmds:

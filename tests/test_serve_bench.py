@@ -37,6 +37,21 @@ def test_serve_bench_smoke_runs_and_keeps_parity(repo_root):
     assert flight["p99_bundle_has_offending_batch_close"] is True
     assert flight["doctor_ok"] is True
     assert flight["suppressed"] > 0  # the rate limit did suppress repeats
+    # the cold-start leg: cold boot compiles fresh and populates the
+    # persistent cache, the second boot deserializes every bucket and the
+    # cached executable's scores stay bit-identical to model_detect.
+    # Both boots pay the same shape-donor batch execution, and at smoke
+    # size that fixed cost compresses the WALL ratio under suite load —
+    # so the live smoke gates the pure compile-vs-deserialize resolution
+    # ratio at 5× with a 1.5× wall floor; the full-size wall-clock ≥5×
+    # gate is enforced on the artifact of record below and in
+    # run_serve_bench main().
+    comp = res["compile"]
+    assert set(comp["cold"]["sources"].values()) == {"fresh"}
+    assert comp["warm_all_cache"] is True
+    assert comp["resolution_speedup"] >= 5.0
+    assert comp["warmup_speedup"] >= 1.5
+    assert comp["warm_parity_bit_identical_to_model_detect"] is True
 
 
 def test_checked_in_swap_artifact_meets_acceptance(repo_root):
@@ -78,3 +93,10 @@ def test_checked_in_serve_artifact_meets_acceptance(repo_root):
     assert art["flight"]["bundles"] == 2
     assert art["flight"]["doctor_ok"] is True
     assert art["flight"]["p99_bundle_has_offending_batch_close"] is True
+    # cold-start acceptance in the artifact of record: warm boot ≥5×
+    # faster than cold, every bucket deserialized, parity preserved
+    comp = art["compile"]
+    assert set(comp["cold"]["sources"].values()) == {"fresh"}
+    assert comp["warm_all_cache"] is True
+    assert comp["warmup_speedup"] >= 5.0
+    assert comp["warm_parity_bit_identical_to_model_detect"] is True
