@@ -7,11 +7,19 @@ Prometheus contract) each became a bug once; every rule here is the
 generalized regression test for one of those bug classes, wired into
 tier-1 so every future PR is analyzed on every test run.
 
-Entry points: ``python scripts/nerrflint.py``, ``nerrf lint`` (CLI),
-``tests/test_analysis.py`` (the tier-1 gate).  See docs/static-analysis.md
-for the rule catalog and how to suppress or add a rule.
+Two tiers: the AST rules here, and the deep (jaxpr-level) program
+contracts in ``nerrf_tpu/analysis/programs/`` — abstract tracing of the
+real serve/train/parallel entry points behind ``nerrf lint --deep``
+(signature closure, donation discipline, collective/sharding
+consistency, Pallas VMEM budgets, cache-key coverage).
 
-Stdlib-only: importing this package must never initialize jax.
+Entry points: ``python scripts/nerrflint.py [--deep]``, ``nerrf lint``
+(CLI), ``tests/test_analysis.py`` / ``tests/test_programs.py`` (the
+tier-1 gates).  See docs/static-analysis.md for the rule catalog and how
+to suppress or add a rule.
+
+Stdlib-only: importing this package must never initialize jax (the deep
+tier imports jax only inside rule execution, and only under --deep).
 """
 
 from nerrf_tpu.analysis.engine import (  # noqa: F401

@@ -11,8 +11,10 @@ full ruleset runs on every test invocation and as a chip-queue pre-flight.
 Surfaces:
 
     python scripts/nerrflint.py              # full ruleset over nerrf_tpu/
+    python scripts/nerrflint.py --deep       # + jaxpr-level contracts
     python -m nerrf_tpu.cli lint [--json]    # same, as a CLI subcommand
-    tests/test_analysis.py                   # the tier-1 gate
+    tests/test_analysis.py                   # the tier-1 gate (AST tier)
+    tests/test_programs.py                   # the tier-1 gate (deep tier)
 
 Suppression, two flavors (both REQUIRE a justification):
 
@@ -83,10 +85,14 @@ class Finding:
 
 class Rule:
     """Base class: subclasses set ``id``/``description`` and implement
-    ``run(project) -> list[Finding]``."""
+    ``run(project) -> list[Finding]``.  ``deep`` marks the jaxpr-level
+    tier (`nerrf_tpu/analysis/programs/`): those rules import jax at run
+    time and only load under ``--deep`` — the base engine stays
+    stdlib-only."""
 
     id: str = ""
     description: str = ""
+    deep: bool = False
 
     def run(self, project: Project) -> List[Finding]:  # pragma: no cover
         raise NotImplementedError
@@ -210,7 +216,14 @@ def analyze(root: Path = REPO, paths: Sequence[str] = DEFAULT_PATHS,
 
     raw: List[Finding] = []
     for rule in rules:
-        raw.extend(rule.run(project))
+        try:
+            raw.extend(rule.run(project))
+        except Exception as e:  # noqa: BLE001 — a crashed rule is exit 2,
+            # not a traceback: the pre-flights must distinguish "the
+            # analyzer broke" from "the code has findings"
+            errors.append(
+                f"rule {rule.id or type(rule).__name__} crashed: "
+                f"{type(e).__name__}: {e}")
     raw.sort(key=lambda f: (f.path, f.line, f.rule))
 
     seen_keys = set()
@@ -247,9 +260,22 @@ def main(argv=None) -> int:
                     help="machine-readable report on stdout")
     ap.add_argument("--list-rules", action="store_true",
                     help="print the rule catalog and exit")
+    ap.add_argument("--deep", action="store_true",
+                    help="also run the jaxpr-level program-contract rules "
+                         "(signature closure, donation, collectives, "
+                         "Pallas budgets, cache-key coverage) — imports "
+                         "jax and forces a virtual multi-device CPU "
+                         "backend; ~20 s instead of ~2 s")
     args = ap.parse_args(argv)
 
     rules = default_rules()
+    if args.deep:
+        # rule construction is jax-free; the backend setup (jax import,
+        # XLA_FLAGS) waits until rules actually run, so --list-rules
+        # stays instant even with --deep
+        from nerrf_tpu.analysis.programs import deep_rules
+
+        rules += deep_rules()
     if args.list_rules:
         for r in rules:
             print(f"{r.id:<20} {r.description}")
@@ -263,6 +289,10 @@ def main(argv=None) -> int:
             return 2
         rules = [known[rid] for rid in args.rule]
 
+    if any(getattr(r, "deep", False) for r in rules):
+        from nerrf_tpu.analysis.programs import prepare_backend
+
+        prepare_backend()
     report = analyze(
         Path(args.root), DEFAULT_PATHS, rules,
         Path(args.baseline) if args.baseline else None)

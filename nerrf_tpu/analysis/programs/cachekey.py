@@ -1,0 +1,131 @@
+"""cache-key-coverage: the stale-executable hazard class, statically.
+
+PR 7's compile cache fingerprints (program, argument avals, caller
+``extra`` material, environment).  Anything else a lowered program
+depends on — a closure-captured array baked in as a constant, a config
+scalar that constant-folds into the HLO but is missing from ``extra`` —
+is a *stale-cache hazard*: two processes that differ along that axis
+compute the same fingerprint and one of them deserializes the other's
+(wrong) executable.  This is the hazard class the poisoned-payload bug
+PR 7's pre-flight caught belongs to; this rule makes the whole class a
+CPU pre-flight failure.
+
+Two checks per cache-keyed entry point:
+
+  * **closure captures** — `jax.make_jaxpr` over abstract avals; every
+    constant ≥ ``min_const_bytes`` baked into the jaxpr is flagged (the
+    fingerprint hashes argument avals; a capture is not an argument —
+    the `make_train_step_resident` rule exists precisely so dataset
+    arrays ride as jit *parameters*).
+  * **axis sensitivity** — each entry carries config variants whose
+    argument avals are IDENTICAL but whose lowered programs differ
+    (pos_weight, aggregation routing...).  For every variant pair:
+    jaxprs differ ⇒ fingerprints must differ.  A pair with different
+    programs and equal fingerprints is an uncovered key axis — the
+    ``extra`` material (`step_key_extra` / `serve_program_key`) has a
+    hole.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from nerrf_tpu.analysis.engine import Finding, Rule
+from nerrf_tpu.analysis.programs.abstract import (
+    CacheKeyEntry,
+    big_consts,
+    finding,
+    program_identity,
+)
+
+# the env axis is orthogonal to what this rule checks (same process, same
+# backend for every variant) — a fixed stub keeps the pass device-free
+_ENV_STUB = {"static": "analysis"}
+
+
+class CacheKeyCoverage(Rule):
+    id = "cache-key-coverage"
+    description = ("closure captures and config axes a jaxpr depends on "
+                   "that the CompileCache fingerprint cannot see")
+    deep = True
+
+    def __init__(self, entries: Optional[List[CacheKeyEntry]] = None) -> None:
+        self._entries = entries
+
+    def run(self, project) -> List[Finding]:
+        if self._entries is None:
+            from nerrf_tpu.analysis.programs.entries import cache_key_entries
+
+            entries = cache_key_entries()
+        else:
+            entries = self._entries
+        out: List[Finding] = []
+        for entry in entries:
+            out.extend(self._check(entry))
+        return out
+
+    def _check(self, entry: CacheKeyEntry) -> List[Finding]:
+        import jax
+
+        from nerrf_tpu.compilecache.cache import (
+            aval_signature,
+            compute_fingerprint,
+        )
+
+        out: List[Finding] = []
+        traced = []
+        for label, build, extra in entry.variants:
+            try:
+                fn, args = build()
+                closed = jax.make_jaxpr(fn)(*args)
+            except Exception as e:  # noqa: BLE001 — report, don't crash
+                out.append(finding(
+                    self.id, entry.path, 1,
+                    anchor=f"cachekey:{entry.name}:{label}:trace",
+                    message=f"{entry.name}[{label}]: abstract trace "
+                            f"failed ({type(e).__name__}: {e})",
+                    hint="the cache-key audit needs the program to trace "
+                         "over ShapeDtypeStructs"))
+                continue
+            avals = aval_signature(args, {})
+            fp, _ = compute_fingerprint(entry.name, avals, extra,
+                                        env=_ENV_STUB)
+            traced.append((label, program_identity(closed), fp))
+            # every variant: a capture present only under a non-base
+            # config is just as much a stale-cache hazard (the engine
+            # dedups identical anchors when both variants carry it)
+            for shape, dtype, nbytes in big_consts(
+                    closed, entry.min_const_bytes):
+                out.append(finding(
+                    self.id, entry.path, 1,
+                    anchor=f"cachekey:{entry.name}:const:"
+                           f"{'x'.join(map(str, shape)) or 'scalar'}:"
+                           f"{dtype}",
+                    message=f"{entry.name}: a {nbytes}-byte "
+                            f"closure-captured {dtype}{list(shape)} "
+                            f"constant is baked into the jaxpr but "
+                            f"invisible to the cache fingerprint — "
+                            f"a process with a different capture "
+                            f"would reuse this executable",
+                    hint="pass the array as a jit parameter "
+                         "(the make_train_step_resident rule) or "
+                         "fold a digest of it into the program's "
+                         "`extra` key material"))
+        base = traced[0] if traced else None
+        for label, ident, fp in traced[1:]:
+            b_label, b_ident, b_fp = base
+            if ident != b_ident and fp == b_fp:
+                out.append(finding(
+                    self.id, entry.path, 1,
+                    anchor=f"cachekey:{entry.name}:{label}:uncovered",
+                    message=f"{entry.name}: config axis `{label}` "
+                            f"changes the lowered program but not the "
+                            f"cache fingerprint — a run on the other "
+                            f"side of this axis deserializes a stale "
+                            f"executable",
+                    hint="add the axis to the program's key material "
+                         "(train: step_key_extra; serve: "
+                         "serve_program_key) — conservative over-keying "
+                         "costs one compile, a stale hit costs "
+                         "correctness"))
+        return out

@@ -1,0 +1,188 @@
+"""program-closure: a static proof of the serve zero-recompile contract.
+
+PR 3's contract — every program the scorer can ever launch is compiled at
+`start()` — has only ever been *asserted* dynamically (run_serve_bench
+counts recompiles over a finite stream mix).  This rule proves it
+abstractly, in three steps:
+
+  1. **Reachable set.** Admission lowers a window through
+     `select_bucket` → `ServeConfig.dataset_config` → `window_sample`,
+     and `train.data.sample_spec` is the static shape authority for that
+     lowering: the reachable signature set is exactly
+     ``{batch_signature(spec(bucket) × batch_size) : bucket ∈ ladder}``.
+     A probe sweep over bucket-corner need values re-derives that
+     `select_bucket` can never mint a bucket outside the ladder.
+  2. **Warmup-compiled set.** `serve.service.warmup_batches` — the same
+     generator `_warmup` compiles from — yields the donor batches.  A
+     bucket the donor trace cannot fill is silently *skipped* by warmup
+     today, leaving a reachable-but-cold program whose first live window
+     pays the full XLA compile inside the latency SLO: flagged here.
+  3. **Equality + well-formedness.** Per bucket, the warmup signature
+     must equal the spec signature (a data-dependent shape anywhere in
+     the lowering would split them), and `jax.eval_shape` over the ladder
+     extremes proves the eval program traces at those avals — no devices,
+     no data, no compile.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from nerrf_tpu.analysis.engine import Finding, Rule
+from nerrf_tpu.analysis.programs.abstract import (
+    aval,
+    avals_of_spec,
+    finding,
+    locate,
+    micro_serve_model,
+    param_avals,
+)
+
+_ENTRY = ("nerrf_tpu.serve.service", "warmup_batches")
+
+
+class SignatureClosure(Rule):
+    id = "program-closure"
+    description = ("serve-ladder signature closure: warmup-compiled set "
+                   "== admission-reachable set, proven via sample_spec + "
+                   "eval_shape (no devices)")
+    deep = True
+
+    def __init__(self, serve_cfg=None, expected_spec=None,
+                 trace_extremes: bool = True) -> None:
+        self._serve_cfg = serve_cfg
+        # test seam: a lying spec simulates warmup/admission shape drift
+        self._spec = expected_spec
+        self._trace_extremes = trace_extremes
+
+    def run(self, project) -> List[Finding]:
+        from nerrf_tpu.serve.config import (
+            ServeConfig,
+            bucket_tag,
+            select_bucket,
+        )
+        from nerrf_tpu.serve.service import batch_signature, warmup_batches
+        from nerrf_tpu.train.data import sample_spec
+
+        cfg = self._serve_cfg if self._serve_cfg is not None else ServeConfig()
+        spec_fn = self._spec or sample_spec
+        path, line = locate(project, *_ENTRY)
+        out: List[Finding] = []
+
+        # 1. admission-reachable signatures, from the shape authority
+        reachable = {}
+        for bucket in cfg.buckets:
+            spec = spec_fn(cfg.dataset_config(bucket))
+            reachable[bucket_tag(bucket)] = tuple(sorted(
+                (k, (cfg.batch_size,) + tuple(shape), dtype)
+                for k, (shape, dtype) in spec.items()))
+
+        # select_bucket can only return ladder members (or reject): probe
+        # the corner need values of every bucket, plus one past the top
+        probes = [(b[0], b[1], b[2]) for b in cfg.buckets]
+        probes += [(b[0] - 1 or 1, b[1] - 1 or 1, max(b[2] - 1, 1))
+                   for b in cfg.buckets]
+        top = max(cfg.buckets)
+        probes.append((top[0] + 1, top[1] + 1, top[2] + 1))
+        for n, e, s in probes:
+            sel = select_bucket(n, e, s, cfg.buckets)
+            if sel is not None and sel not in cfg.buckets:
+                out.append(finding(
+                    self.id, path, line,
+                    anchor=f"closure:select:{n}n/{e}e/{s}s",
+                    message=f"select_bucket({n}, {e}, {s}) returned "
+                            f"{sel}, which is not in the configured "
+                            f"ladder — admission can mint a shape outside "
+                            f"the warmup-compiled set",
+                    hint="select_bucket must only ever return members of "
+                         "cfg.buckets or None (reject)"))
+
+        # 2. warmup-compiled signatures, from the donor generator
+        warmed = {}
+        for bucket, tag, batch in warmup_batches(cfg):
+            warmed[tag] = batch_signature(batch)
+
+        # 3. closure: every reachable bucket warmed, at the same signature
+        for tag, want in reachable.items():
+            got = warmed.get(tag)
+            if got is None:
+                out.append(finding(
+                    self.id, path, line,
+                    anchor=f"closure:{tag}:unwarmed",
+                    message=f"bucket {tag} is reachable at admission but "
+                            f"absent from the warmup-compiled set (the "
+                            f"donor trace yields no sample for it) — the "
+                            f"first live window in this bucket pays the "
+                            f"full XLA compile on the serving path",
+                    hint="make the warmup donor trace fill every "
+                         "configured bucket (serve/service.py "
+                         "warmup_batches), or drop the bucket from the "
+                         "ladder"))
+                continue
+            if got != want:
+                diff = sorted(set(want).symmetric_difference(got))
+                out.append(finding(
+                    self.id, path, line,
+                    anchor=f"closure:{tag}:signature",
+                    message=f"bucket {tag}: warmup compiles a different "
+                            f"signature than admission produces "
+                            f"(drift in {sorted({d[0] for d in diff})}) "
+                            f"— every live window recompiles",
+                    hint="warmup and admission must both lower through "
+                         "ServeConfig.dataset_config + window_sample; "
+                         "sample_spec is the shape authority"))
+
+        # 4. the extreme rungs trace abstractly (proves the programs are
+        # well-formed at the ladder bounds without compiling anything)
+        if self._trace_extremes and warmed:
+            out.extend(self._trace(cfg, path, line))
+        return out
+
+    def _trace(self, cfg, path: str, line: int) -> List[Finding]:
+        import jax
+
+        from nerrf_tpu.serve.config import bucket_tag
+        from nerrf_tpu.train.data import sample_spec
+        from nerrf_tpu.train.loop import make_eval_fn
+
+        out: List[Finding] = []
+        model = micro_serve_model()
+        eval_fn = make_eval_fn(model)
+        params: Optional[object] = None
+        for bucket in (min(cfg.buckets), max(cfg.buckets)):
+            tag = bucket_tag(bucket)
+            spec = sample_spec(cfg.dataset_config(bucket))
+            sample = avals_of_spec(spec)
+            batch = avals_of_spec(spec, batch=cfg.batch_size)
+            try:
+                if params is None:  # shape-polymorphic: any bucket works
+                    params = param_avals(model, sample)
+                res = jax.eval_shape(eval_fn, params, batch)
+            except Exception as e:  # noqa: BLE001 — the finding IS the point
+                out.append(finding(
+                    self.id, path, line,
+                    anchor=f"closure:{tag}:trace",
+                    message=f"bucket {tag}: the eval program does not "
+                            f"trace at the admission avals "
+                            f"({type(e).__name__}: {e})",
+                    hint="run `nerrf lint --deep` after any model-input "
+                         "or sample-layout change; this failure would "
+                         "otherwise surface at warmup on chip"))
+                continue
+            # separate contract, separate diagnostic: a program that
+            # traces but emits the wrong node-score shape would break
+            # the demux, not the compile
+            got = tuple(res["node_logit"].shape)
+            want = (cfg.batch_size, bucket[0])
+            if got != want:
+                out.append(finding(
+                    self.id, path, line,
+                    anchor=f"closure:{tag}:output-shape",
+                    message=f"bucket {tag}: the eval program's "
+                            f"node_logit is {got}, the demux expects "
+                            f"{want} — per-node scores would misalign "
+                            f"with the bucket's node slots",
+                    hint="node_logit must stay [batch, bucket "
+                         "max_nodes]; check the model head and the "
+                         "sample layout"))
+        return out

@@ -1,0 +1,172 @@
+"""collective-consistency: shard_map/pjit axis and sharding validation.
+
+The pod-scale serving item will push the `parallel/` shims — today
+exercised only on a virtual CPU mesh in tests — under real multi-host
+meshes, where an invalid axis name or a rank-mismatched PartitionSpec
+surfaces as a GSPMD partitioning error minutes into a pod boot.  This
+rule runs the same validation abstractly on CPU:
+
+  * **collective axes** — `ring_self_attention` (the one hand-written
+    collective program) is traced to a jaxpr on a virtual 2-device mesh
+    and every collective eqn reachable in it (psum/ppermute/axis_index,
+    nested bodies included) must name only axes of the declared mesh;
+    ppermute permutations must additionally be in-range bijections of the
+    axis.  A trace *failure* is itself a finding — the crash the chip
+    queue would otherwise hit.
+  * **sharding ranks** — every declared pjit layout row from
+    `parallel.train.sharding_contract` (built from the real
+    batch_sharding/stream_shardings calls) must rank-fit the array it
+    annotates and name only mesh axes.
+
+Needs ≥ 2 devices for the trace leg; `prepare_backend` forces a virtual
+8-device CPU host, and on an exotic single-device embedder the trace leg
+degrades to the sharding-contract checks (noted on stderr, never a
+silent pass of a failed trace).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from nerrf_tpu.analysis.engine import Finding, Rule
+from nerrf_tpu.analysis.programs.abstract import (
+    CollectiveEntry,
+    collectives_in,
+    finding,
+    locate,
+    note,
+)
+
+_CONTRACT_PATH = "nerrf_tpu/parallel/train.py"
+
+
+class CollectiveConsistency(Rule):
+    id = "collective-consistency"
+    description = ("collective axis names vs the mesh spec and "
+                   "PartitionSpec rank-match over the shard_map/pjit shims")
+    deep = True
+
+    def __init__(self, entries: Optional[List[CollectiveEntry]] = None,
+                 contracts: Optional[list] = None) -> None:
+        self._entries = entries
+        self._contracts = contracts
+
+    def run(self, project) -> List[Finding]:
+        import jax
+
+        out: List[Finding] = []
+        if self._entries is not None:
+            entries = self._entries
+        elif len(jax.devices()) >= 2:
+            from nerrf_tpu.analysis.programs.entries import collective_entries
+
+            entries = collective_entries()
+        else:
+            note("collective-consistency: <2 devices, skipping the "
+                 "shard_map trace leg (sharding contracts still checked)")
+            entries = []
+        for entry in entries:
+            out.extend(self._check_entry(project, entry))
+        if self._contracts is not None:
+            contracts = self._contracts
+        else:
+            from nerrf_tpu.analysis.programs.entries import sharding_contracts
+
+            contracts = sharding_contracts()
+        out.extend(self._check_contracts(project, contracts))
+        return out
+
+    def _check_entry(self, project, entry: CollectiveEntry) -> List[Finding]:
+        import jax
+
+        line = 1
+        out: List[Finding] = []
+        try:
+            fn, args = entry.build()
+            closed = jax.make_jaxpr(fn)(*args)
+        except Exception as e:  # noqa: BLE001 — the finding IS the point
+            out.append(finding(
+                self.id, entry.path, line,
+                anchor=f"collective:{entry.name}:trace",
+                message=f"{entry.name}: abstract trace failed "
+                        f"({type(e).__name__}: {e}) — this program would "
+                        f"crash at partitioning time on a real mesh",
+                hint="reproduce with jax.make_jaxpr over ShapeDtypeStructs "
+                     "on a 2-device CPU mesh (XLA_FLAGS="
+                     "--xla_force_host_platform_device_count=8)"))
+            return out
+        allowed = set(entry.mesh_axes)
+        for prim, axes, params in collectives_in(closed):
+            bad = [a for a in axes if a not in allowed]
+            if bad:
+                out.append(finding(
+                    self.id, entry.path, line,
+                    anchor=f"collective:{entry.name}:{prim}:"
+                           f"{'+'.join(bad)}",
+                    message=f"{entry.name}: collective `{prim}` names "
+                            f"axis/axes {bad} not in the mesh spec "
+                            f"{sorted(allowed)}",
+                    hint="every axis a collective names must exist in "
+                         "the Mesh the shard_map runs under"))
+            if prim == "ppermute":
+                out.extend(self._check_perm(entry, params, line))
+        return out
+
+    def _check_perm(self, entry, params, line) -> List[Finding]:
+        out: List[Finding] = []
+        perm = params.get("perm")
+        axes = params.get("axis_name", ())
+        if isinstance(axes, str):
+            axes = (axes,)
+        size = None
+        for a in axes:
+            size = entry.axis_sizes.get(str(a), size)
+        if perm is None or size is None:
+            return out
+        srcs = [p[0] for p in perm]
+        dsts = [p[1] for p in perm]
+        in_range = all(0 <= v < size for v in srcs + dsts)
+        bijective = len(set(srcs)) == len(srcs) and \
+            len(set(dsts)) == len(dsts)
+        if not (in_range and bijective):
+            out.append(finding(
+                self.id, entry.path, line,
+                anchor=f"collective:{entry.name}:ppermute:perm",
+                message=f"{entry.name}: ppermute permutation {perm} is "
+                        f"not an in-range bijection of axis size {size} "
+                        f"— shards would send to/receive from nowhere",
+                hint="build the ring as [(j, (j+1) % size) for j in "
+                     "range(size)]"))
+        return out
+
+    def _check_contracts(self, project, contracts) -> List[Finding]:
+        path, line = _CONTRACT_PATH, 1
+        if project is not None:
+            path, line = locate(project, "nerrf_tpu.parallel.train",
+                                "sharding_contract")
+        out: List[Finding] = []
+        for prog, array, spec, ndim, mesh_axes in contracts:
+            entries = [a for a in tuple(spec) if a is not None]
+            flat = []
+            for a in entries:
+                flat.extend(a if isinstance(a, (tuple, list)) else (a,))
+            bad = [a for a in flat if a not in mesh_axes]
+            if bad:
+                out.append(finding(
+                    self.id, path, line,
+                    anchor=f"sharding:{prog}:{array}:axes",
+                    message=f"{prog}: PartitionSpec for `{array}` names "
+                            f"axis/axes {bad} not in the mesh "
+                            f"{list(mesh_axes)}",
+                    hint="specs must only name declared mesh axes"))
+            if len(tuple(spec)) > ndim:
+                out.append(finding(
+                    self.id, path, line,
+                    anchor=f"sharding:{prog}:{array}:rank",
+                    message=f"{prog}: PartitionSpec {tuple(spec)} for "
+                            f"`{array}` has rank {len(tuple(spec))} but "
+                            f"the array is rank {ndim} — GSPMD rejects "
+                            f"this at partitioning time",
+                    hint="a spec may be shorter than the array rank "
+                         "(trailing dims replicate) but never longer"))
+        return out

@@ -1,0 +1,357 @@
+"""donation-discipline: buffer-donation contracts over the lowered programs.
+
+Donation is the difference between flagship training fitting in HBM once
+or twice: the train state (params + opt_state, 3× params with adamw) must
+be donated into every step, and the donation must actually *alias* — a
+donated input XLA cannot match to an output is silently freed-and-
+reallocated (the "Some donated buffers were not usable" warning, which on
+a queue run scrolls past unread).  The serve scorer has the opposite
+contract: its params are shared across every batch and stream, so nothing
+may be donated there at all.
+
+Three checks per lowered entry (`jit.lower` over abstract avals — the
+aliasing decision is made at lowering, so no device and no compile):
+
+  * **must-donate** — argnums holding large reusable state are declared
+    donated;
+  * **wasted donation** — every leaf of a donated argnum carries
+    ``tf.aliasing_output`` in the StableHLO module (XLA committed to the
+    reuse); donated-but-unaliased leaves are flagged;
+  * **forbidden donation** — entries with ``donate=()`` (the serve eval)
+    lower with zero aliased inputs.
+
+Plus two AST checks over the train/parallel sources (the caller side of
+the contract, where the jaxpr cannot see):
+
+  * **donated-then-read** — a variable passed in donated position to a
+    known donating step and *read again* after the call without being
+    rebound by it (the classic use-after-donate, which on TPU is a
+    runtime "buffer has been deleted" mid-run);
+  * **double donation** — the same variable passed in two donated
+    positions of one call (both slots alias one buffer).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from nerrf_tpu.analysis.astutil import body_nodes, dotted
+from nerrf_tpu.analysis.engine import Finding, Rule
+from nerrf_tpu.analysis.programs.abstract import (
+    DonationEntry,
+    alias_attrs,
+    finding,
+    leaf_paths,
+)
+
+# factories whose results donate their first positional argument (the
+# TrainState / flat-state slot) — the AST checks key call sites off these
+_DONATING_FACTORIES = {
+    "make_train_step": (0,),
+    "make_flat_train_step": (0, 1),
+    "make_flat_step": (0, 1),
+    "make_sharded_train_step": (0,),
+    "make_train_step_resident": (0,),
+    "make_train_step_scheduled": (0,),
+    "make_train_superstep": (0,),
+    "cache_train_step": (0,),
+    "CachedTrainStep": (0,),
+}
+
+_AST_SCOPE = ("nerrf_tpu/train/", "nerrf_tpu/parallel/")
+
+
+class DonationDiscipline(Rule):
+    id = "donation-discipline"
+    description = ("donated-then-read, un-donated large train buffers and "
+                   "wasted/double donation over the lowered flat step")
+    deep = True
+
+    def __init__(self, entries: Optional[List[DonationEntry]] = None,
+                 ast_scope: Tuple[str, ...] = _AST_SCOPE) -> None:
+        self._entries = entries
+        self._ast_scope = ast_scope
+
+    def run(self, project) -> List[Finding]:
+        if self._entries is None:
+            from nerrf_tpu.analysis.programs.entries import donation_entries
+
+            entries = donation_entries()
+        else:
+            entries = self._entries
+        out: List[Finding] = []
+        for entry in entries:
+            out.extend(self._check_entry(entry))
+        if project is not None:
+            out.extend(self._check_ast(project))
+        return out
+
+    # -- lowered-module checks ------------------------------------------------
+
+    def _check_entry(self, entry: DonationEntry) -> List[Finding]:
+        import jax
+
+        out: List[Finding] = []
+        fn, args = entry.build()
+        for argnum in entry.must_donate:
+            if argnum not in entry.donate:
+                out.append(finding(
+                    self.id, entry.path, 1,
+                    anchor=f"donation:{entry.name}:arg{argnum}:undonated",
+                    message=f"{entry.name}: argument {argnum} holds large "
+                            f"reusable state but is not donated — peak "
+                            f"memory doubles at flagship shapes",
+                    hint="add the argnum to donate_argnums (and keep the "
+                         "caller from reusing the buffer)"))
+        jitted = fn if hasattr(fn, "lower") else jax.jit(
+            fn, donate_argnums=entry.donate)
+        lowered = jitted.lower(*args)
+        verdicts = alias_attrs(lowered.as_text())
+        if verdicts is None:
+            out.append(finding(
+                self.id, entry.path, 1,
+                anchor=f"donation:{entry.name}:unparseable",
+                message=f"{entry.name}: could not locate the lowered "
+                        f"main signature to verify donation aliasing",
+                hint="jax lowering text layout changed; update "
+                     "analysis/programs/abstract.alias_attrs"))
+            return out
+        # flat leaf ranges per top-level argnum
+        paths: List[str] = []
+        owner: List[int] = []
+        for i, a in enumerate(args):
+            for p in leaf_paths(a):
+                paths.append(f"arg{i}{p}")
+                owner.append(i)
+        if len(verdicts) != len(paths):
+            # tokens/dim args or pruned inputs: degrade to the coarse
+            # check — BOTH directions (a donate=() entry with any aliased
+            # arg is the forbidden-donation hazard, coarse or not)
+            aliased = sum(verdicts)
+            want = sum(len(leaf_paths(args[i])) for i in entry.donate
+                       if i < len(args))
+            if aliased < want:
+                out.append(finding(
+                    self.id, entry.path, 1,
+                    anchor=f"donation:{entry.name}:coarse",
+                    message=f"{entry.name}: only {aliased} of {want} "
+                            f"donated leaves are aliased in the lowered "
+                            f"module (leaf mapping unavailable: "
+                            f"{len(verdicts)} lowered args vs "
+                            f"{len(paths)} leaves)",
+                    hint="donated buffers without a matching output are "
+                         "freed and reallocated — check shapes/dtypes of "
+                         "the returned state"))
+            elif aliased > want:
+                out.append(finding(
+                    self.id, entry.path, 1,
+                    anchor=f"donation:{entry.name}:coarse-forbidden",
+                    message=f"{entry.name}: {aliased} lowered arguments "
+                            f"are aliased to outputs but the entry "
+                            f"declares only {want} donated leaves (leaf "
+                            f"mapping unavailable) — an undeclared "
+                            f"donation would free a shared buffer",
+                    hint="serve-side programs must never donate: their "
+                         "params are shared across batches and streams"))
+            return out
+        donate = set(entry.donate)
+        for i, (is_aliased, path_str) in enumerate(zip(verdicts, paths)):
+            if owner[i] in donate and not is_aliased:
+                out.append(finding(
+                    self.id, entry.path, 1,
+                    anchor=f"donation:{entry.name}:{path_str}:wasted",
+                    message=f"{entry.name}: donated leaf {path_str} has "
+                            f"no aliased output in the lowered module — "
+                            f"the donation frees nothing (XLA's 'donated "
+                            f"buffers were not usable' warning, as a "
+                            f"pre-flight failure)",
+                    hint="the returned state must carry a leaf of the "
+                         "same shape/dtype for every donated input leaf"))
+            elif owner[i] not in donate and is_aliased:
+                out.append(finding(
+                    self.id, entry.path, 1,
+                    anchor=f"donation:{entry.name}:{path_str}:forbidden",
+                    message=f"{entry.name}: input {path_str} is aliased "
+                            f"to an output but the entry declares no "
+                            f"donation — a shared buffer (serve params) "
+                            f"would be overwritten in place",
+                    hint="serve-side programs must never donate: their "
+                         "params are shared across batches and streams"))
+        return out
+
+    # -- AST checks (the caller side) -----------------------------------------
+
+    def _check_ast(self, project) -> List[Finding]:
+        out: List[Finding] = []
+        for mod in project.modules.values():
+            if not any(mod.path.startswith(s) for s in self._ast_scope):
+                continue
+            # scope discipline: a function sees module-level bindings,
+            # its enclosing functions' bindings (closures — the resident
+            # step factories bind jitted steps their inner defs call),
+            # and its own; a name bound to a donating factory inside one
+            # function must NOT taint a same-named non-donating callable
+            # in an unrelated function of the module
+            module_level = self._donating_names(
+                n for n in ast.iter_child_nodes(mod.tree)
+                if isinstance(n, ast.Assign))
+            local = {
+                fi.qualname: self._donating_names(
+                    n for n in body_nodes(fi.node)
+                    if isinstance(n, ast.Assign))
+                for fi in mod.functions}
+            for fi in mod.functions:
+                donating = dict(module_level)
+                for outer, names in local.items():
+                    if fi.qualname == outer or \
+                            fi.qualname.startswith(f"{outer}.<locals>."):
+                        donating.update(names)
+                if donating:
+                    out.extend(self._check_fn(mod, fi, donating))
+        return out
+
+    @staticmethod
+    def _donating_names(assigns) -> Dict[str, Tuple[int, ...]]:
+        """Names bound (by the given Assign nodes) to donating step
+        callables: factory results plus direct
+        ``jax.jit(..., donate_argnums=...)`` bindings."""
+        names: Dict[str, Tuple[int, ...]] = {}
+        for node in assigns:
+            if not isinstance(node.value, ast.Call):
+                continue
+            targets = [t.id for t in node.targets
+                       if isinstance(t, ast.Name)]
+            if not targets:
+                continue
+            d = dotted(node.value.func)
+            if d is None:
+                continue
+            base = d.split(".")[-1]
+            if base in _DONATING_FACTORIES:
+                for t in targets:
+                    names[t] = _DONATING_FACTORIES[base]
+            elif d in ("jax.jit", "jit"):
+                for kw in node.value.keywords:
+                    if kw.arg == "donate_argnums":
+                        nums = tuple(
+                            c.value for c in ast.walk(kw.value)
+                            if isinstance(c, ast.Constant)
+                            and isinstance(c.value, int))
+                        if nums:
+                            for t in targets:
+                                names[t] = nums
+        return names
+
+    def _check_fn(self, mod, fi, donating) -> List[Finding]:
+        node = fi.node
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return []
+        out: List[Finding] = []
+        # nearest-enclosing-statement index (a call inside a `for` must
+        # map to its own Assign, not the loop): parent map, then walk up
+        parent: Dict[int, ast.AST] = {}
+        for n in body_nodes(node):
+            for child in ast.iter_child_nodes(n):
+                parent.setdefault(id(child), n)
+
+        def stmt_of(n) -> Optional[ast.stmt]:
+            while n is not None and not isinstance(n, ast.stmt):
+                n = parent.get(id(n))
+            return n
+
+        def branch_of(n, branch_point: ast.If) -> Optional[str]:
+            """Which arm of ``branch_point`` holds ``n``: the direct
+            child on n's ancestor chain tells (None when n is the If
+            itself or its test)."""
+            child, cur = n, parent.get(id(n))
+            while cur is not None and cur is not branch_point:
+                child, cur = cur, parent.get(id(cur))
+            if cur is not branch_point:
+                return None
+            if any(child is s for s in branch_point.body):
+                return "body"
+            if any(child is s for s in branch_point.orelse):
+                return "orelse"
+            return None
+
+        def mutually_exclusive(a, b) -> bool:
+            """True when ``a`` and ``b`` sit in different arms of a
+            shared If: line order alone would call b 'after' a, but only
+            one arm ever executes."""
+            chain_a = set()
+            n = a
+            while n is not None:
+                chain_a.add(id(n))
+                n = parent.get(id(n))
+            n = b
+            while n is not None:
+                if isinstance(n, ast.If) and id(n) in chain_a:
+                    arm_a, arm_b = branch_of(a, n), branch_of(b, n)
+                    if arm_a and arm_b and arm_a != arm_b:
+                        return True
+                n = parent.get(id(n))
+            return False
+        calls = [n for n in body_nodes(node) if isinstance(n, ast.Call)
+                 and isinstance(n.func, ast.Name)
+                 and n.func.id in donating]
+        reads = [n for n in body_nodes(node) if isinstance(n, ast.Name)
+                 and isinstance(n.ctx, ast.Load)]
+        rebinds = [n for n in body_nodes(node) if isinstance(n, ast.Name)
+                   and isinstance(n.ctx, ast.Store)]
+        for call in calls:
+            donate = donating[call.func.id]
+            named = {i: a.id for i, a in enumerate(call.args)
+                     if i in donate and isinstance(a, ast.Name)}
+            # double donation: one variable in two donated slots
+            seen: Dict[str, int] = {}
+            for i, name in named.items():
+                if name in seen:
+                    out.append(finding(
+                        self.id, mod.path, call.lineno,
+                        anchor=f"{fi.qualname}:double:{name}",
+                        message=f"`{name}` is passed in two donated "
+                                f"positions ({seen[name]} and {i}) of "
+                                f"{call.func.id} in {fi.qualname} — both "
+                                f"slots alias one buffer and the program "
+                                f"writes it twice",
+                        hint="donate distinct buffers; pass a copy if the "
+                             "two slots genuinely share initial state"))
+                seen.setdefault(name, i)
+            # donated-then-read: the name is read after the call without
+            # the call's own statement rebinding it
+            stmt = stmt_of(call)
+            rebound_here = set()
+            if isinstance(stmt, ast.Assign):
+                for t in stmt.targets:
+                    for sub in ast.walk(t):
+                        if isinstance(sub, ast.Name):
+                            rebound_here.add(sub.id)
+            for name in set(named.values()) - rebound_here:
+                next_rebind = min(
+                    (r.lineno for r in rebinds
+                     if r.id == name and r.lineno > call.lineno),
+                    default=1 << 30)
+                # reads inside the call's own statement (multi-line
+                # argument expressions) are evaluated BEFORE the
+                # donation happens, and reads in the OTHER arm of a
+                # shared If can never follow it — only genuinely later
+                # statements can use-after-donate
+                late = [r for r in reads
+                        if r.id == name and call.lineno < r.lineno
+                        and r.lineno <= next_rebind
+                        and stmt_of(r) is not stmt
+                        and not mutually_exclusive(call, r)]
+                if late:
+                    out.append(finding(
+                        self.id, mod.path, late[0].lineno,
+                        anchor=f"{fi.qualname}:use-after-donate:{name}",
+                        message=f"`{name}` is donated into "
+                                f"{call.func.id} at line {call.lineno} "
+                                f"of {fi.qualname} and read again at "
+                                f"line {late[0].lineno} — on TPU the "
+                                f"buffer is deleted by then",
+                        hint="rebind the result over the donated name "
+                             "(`state, ... = step(state, ...)`) or read "
+                             "what you need before the call"))
+        return out

@@ -1,0 +1,189 @@
+"""The real entry points the deep pass traces, as rule-consumable specs.
+
+One registry so the contracts and the production code can only drift in
+one place: the serve ladder resolves through `serve.config.ServeConfig` +
+`serve.service.warmup_batches` (exactly what `start()` compiles), the
+train boundary through `train.loop.make_flat_train_step` (exactly what the
+compile cache serializes), the shard_map shim through
+`parallel.ring.ring_self_attention`, and the sharding layout through
+`parallel.train.sharding_contract`.  Everything is built at *micro* model
+scale — the contracts quantify over program structure (jit boundaries,
+donation specs, collective axes, key material), which is config-size
+independent, and micro tensors keep each abstract trace ~1 s.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from nerrf_tpu.analysis.programs.abstract import (
+    CacheKeyEntry,
+    CollectiveEntry,
+    DonationEntry,
+    aval,
+    avals_of_spec,
+    micro_train_config,
+    param_avals,
+)
+
+TRAIN_LOOP = "nerrf_tpu/train/loop.py"
+SERVE_SERVICE = "nerrf_tpu/serve/service.py"
+RING = "nerrf_tpu/parallel/ring.py"
+PARALLEL_TRAIN = "nerrf_tpu/parallel/train.py"
+
+
+def _micro_ds_cfg():
+    from nerrf_tpu.graph import GraphConfig
+    from nerrf_tpu.train.data import DatasetConfig
+
+    return DatasetConfig(graph=GraphConfig(max_nodes=64, max_edges=128),
+                         seq_len=16, max_seqs=8)
+
+
+def _micro_batch_avals(batch: int = 2) -> dict:
+    from nerrf_tpu.train.data import sample_spec
+
+    return avals_of_spec(sample_spec(_micro_ds_cfg()), batch=batch)
+
+
+def _abstract_model_args(model_cfg, batch_size: int):
+    """(params avals, batch avals) for a micro-bucket eval/train program
+    — THE one derivation all entry builders share, so the donation and
+    cache-key contracts can never trace differently-constructed args."""
+    from nerrf_tpu.models import NerrfNet
+
+    batch = _micro_batch_avals(batch_size)
+    sample = {k: aval(v.shape[1:], v.dtype) for k, v in batch.items()}
+    return param_avals(NerrfNet(model_cfg), sample), batch
+
+
+def _eval_entry(model_cfg):
+    """(eval jit fn, (params, batch)) — the serve-eval program at micro
+    scale, shared by the donation and cache-key entries."""
+    from nerrf_tpu.models import NerrfNet
+    from nerrf_tpu.train.loop import make_eval_fn
+
+    params, batch = _abstract_model_args(model_cfg, batch_size=2)
+    return make_eval_fn(NerrfNet(model_cfg)), (params, batch)
+
+
+def _flat_step_args(cfg):
+    """Abstract (params, opt_state, step, batch, rng) for the flat train
+    boundary — the exact aval tuple the compile cache fingerprints."""
+    import jax
+    import numpy as np
+
+    from nerrf_tpu.train.loop import make_tx
+
+    params, batch = _abstract_model_args(cfg.model, cfg.batch_size)
+    opt_state = jax.eval_shape(make_tx(cfg).init, params)
+    return (params, opt_state, aval((), np.int32), batch,
+            aval((2,), np.uint32))
+
+
+def donation_entries() -> List[DonationEntry]:
+    cfg = micro_train_config()
+
+    def build_flat_entry():
+        from nerrf_tpu.models import NerrfNet
+        from nerrf_tpu.train.loop import make_flat_train_step
+
+        return (make_flat_train_step(NerrfNet(cfg.model), cfg),
+                _flat_step_args(cfg))
+
+    def build_eval():
+        return _eval_entry(cfg.model)
+
+    return [
+        # the compile-cache boundary: (params, opt_state) donated, both
+        # mandatory (an un-donated flagship state doubles peak HBM)
+        DonationEntry(name="train_step_flat", path=TRAIN_LOOP,
+                      build=build_flat_entry, donate=(0, 1),
+                      must_donate=(0, 1)),
+        # the serve scorer: params are SHARED across every batch and
+        # stream — donation here would free the live weights mid-serve,
+        # so the contract is exactly zero aliased inputs
+        DonationEntry(name="serve_eval", path=TRAIN_LOOP,
+                      build=build_eval, donate=(), must_donate=()),
+    ]
+
+
+def collective_entries() -> List[CollectiveEntry]:
+    def build_ring():
+        import jax
+        import numpy as np
+        from jax.sharding import Mesh
+
+        from nerrf_tpu.parallel.ring import ring_self_attention
+
+        mesh = Mesh(np.array(jax.devices()[:2]).reshape(1, 2),
+                    axis_names=("dp", "sp"))
+        q = aval((2, 16, 2, 8), np.float32)
+        return (lambda qq, kk, vv: ring_self_attention(qq, kk, vv, mesh),
+                (q, q, q))
+
+    return [
+        CollectiveEntry(name="ring_self_attention", path=RING,
+                        build=build_ring, mesh_axes=("dp", "sp"),
+                        axis_sizes={"dp": 1, "sp": 2}),
+    ]
+
+
+def sharding_contracts() -> list:
+    """(program, array, spec, ndim, mesh_axes) rows from the declared pjit
+    layouts — checked without any tracing."""
+    import jax
+
+    from nerrf_tpu.parallel.mesh import MeshConfig, make_mesh
+    from nerrf_tpu.parallel.train import sharding_contract
+
+    mesh = make_mesh(MeshConfig(dp=1, tp=1, sp=1),
+                     devices=jax.devices()[:1])
+    return [(prog, arr, spec, ndim, tuple(mesh.axis_names))
+            for prog, arr, spec, ndim in sharding_contract(mesh)]
+
+
+def cache_key_entries() -> List[CacheKeyEntry]:
+    import dataclasses
+
+    cfg = micro_train_config()
+
+    def train_variant(c, flavor="train_step"):
+        from nerrf_tpu.models import NerrfNet
+        from nerrf_tpu.train.loop import make_flat_train_step, step_key_extra
+
+        def build():
+            return make_flat_train_step(NerrfNet(c.model), c), \
+                _flat_step_args(c)
+
+        return build, step_key_extra(c, flavor)
+
+    def serve_variant(model_cfg):
+        from nerrf_tpu.compilecache import serve_program_key
+
+        return (lambda: _eval_entry(model_cfg),
+                serve_program_key(model_cfg, "64n/128e/8s"))
+
+    # perturbations chosen to change the HLO while keeping the argument
+    # avals IDENTICAL — precisely the drift only the `extra` key material
+    # can catch (aval-changing axes are covered by the avals themselves)
+    cfg_pw = dataclasses.replace(cfg, pos_weight=cfg.pos_weight + 1.0)
+    base_model = cfg.model
+    agg_model = dataclasses.replace(
+        base_model,
+        gnn=dataclasses.replace(base_model.gnn, aggregation="dense_adj"))
+
+    t_base, t_base_extra = train_variant(cfg)
+    t_pw, t_pw_extra = train_variant(cfg_pw)
+    s_base, s_base_extra = serve_variant(base_model)
+    s_agg, s_agg_extra = serve_variant(agg_model)
+    return [
+        CacheKeyEntry(
+            name="train_step_flat", path=TRAIN_LOOP,
+            variants=[("base", t_base, t_base_extra),
+                      ("pos_weight", t_pw, t_pw_extra)]),
+        CacheKeyEntry(
+            name="serve_eval", path=SERVE_SERVICE,
+            variants=[("base", s_base, s_base_extra),
+                      ("aggregation", s_agg, s_agg_extra)]),
+    ]

@@ -495,7 +495,10 @@ def cmd_lint(args) -> int:
     Same engine as scripts/nerrflint.py and the tier-1 gate
     (tests/test_analysis.py); rule catalog in docs/static-analysis.md.
     Deliberately NO jax import — safe on any host, including one with a
-    wedged accelerator tunnel."""
+    wedged accelerator tunnel.  ``--deep`` adds the jaxpr-level
+    program-contract tier (signature closure, donation, collectives,
+    Pallas budgets, cache-key coverage): it imports jax but forces a
+    virtual CPU backend, so it too runs on a tunnel-wedged host."""
     from nerrf_tpu.analysis.engine import main as lint_main
 
     argv = []
@@ -503,6 +506,8 @@ def cmd_lint(args) -> int:
         argv.append("--json")
     if args.list_rules:
         argv.append("--list-rules")
+    if args.deep:
+        argv.append("--deep")
     for rid in args.rule or ():
         argv += ["--rule", rid]
     if args.baseline:
@@ -1182,11 +1187,17 @@ def main(argv=None) -> int:
 
     p = sub.add_parser("lint", help="static analysis over nerrf_tpu's own "
                                     "ASTs (purity, recompile, sync, lock "
-                                    "discipline, metrics contract)")
+                                    "discipline, metrics contract); --deep "
+                                    "adds the jaxpr-level program contracts")
     p.add_argument("--json", action="store_true",
                    help="machine-readable report")
     p.add_argument("--list-rules", action="store_true",
                    help="print the rule catalog and exit")
+    p.add_argument("--deep", action="store_true",
+                   help="also verify the jaxpr-level program contracts "
+                        "(signature closure, donation, collectives, Pallas "
+                        "budgets, cache-key coverage) — abstract tracing "
+                        "on a virtual CPU backend, no devices needed")
     p.add_argument("--rule", action="append", default=None, metavar="ID",
                    help="run only this rule (repeatable)")
     p.add_argument("--baseline", default=None, metavar="FILE",

@@ -72,10 +72,13 @@ class CompileInfo:
     reason: Optional[str] = None   # miss/fallback cause, None on a hit
 
 
-def _aval_signature(args: tuple, kwargs: dict) -> dict:
+def aval_signature(args: tuple, kwargs: dict) -> dict:
     """Canonical (shape, dtype, treedef) description of a call signature —
     the cache key's view of the arguments.  Weak-typed scalars hash by
-    their numpy dtype, which is what the lowered program sees."""
+    their numpy dtype, which is what the lowered program sees.  Public:
+    the deep static pass (analysis/programs/cachekey.py) fingerprints
+    candidate programs through exactly this view, so its coverage proof
+    and the runtime cache can never disagree about what a key sees."""
     import jax
 
     leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
@@ -388,7 +391,7 @@ class CompileCache:
         """
         kwargs = kwargs or {}
         try:
-            avals = _aval_signature(args, kwargs)
+            avals = aval_signature(args, kwargs)
             fp, material = compute_fingerprint(program, avals, extra,
                                                env=self.env())
         except Exception as e:  # noqa: BLE001 — fail-open by contract

@@ -607,6 +607,66 @@ def _gather_bwd(interpret, res, g):
 gather_rows.defvjp(_gather_fwd, _gather_bwd)
 
 
+# --- static resource inventory (the deep-lint surface) -----------------------
+
+
+def kernel_vmem_blocks(num_nodes: int, num_edges: int,
+                       num_features: int) -> dict:
+    """Per-kernel VMEM block inventory at the given (padded-up) problem
+    shape: ``{kernel: [(block, shape, dtype, copies), ...]}``.
+
+    THE static description of what each kernel keeps resident in VMEM per
+    grid cell, mirroring the BlockSpecs/scratch_shapes above — kept next
+    to the kernels so a tiling change and its budget model move in one
+    diff.  ``copies=2`` marks grid-streamed blocks (Mosaic double-buffers
+    the HBM→VMEM copies); scratch and accumulator blocks are single.  The
+    deep static pass (`nerrf lint --deep`, pallas-budget) costs this
+    against the per-core VMEM budget for every serve-ladder bucket, so an
+    over-VMEM tile combination fails on CPU in seconds instead of as a
+    Mosaic allocation error minutes into a chip run."""
+    n_pad = num_nodes + ((-num_nodes) % _TN)
+    del num_edges, num_features  # tiled away (_TE rows / _TF lanes per block)
+    return {
+        "segment_sum": [
+            ("ids", (_TE, 1), "int32", 2),
+            ("data", (_TE, _TF), "float32", 2),
+            ("out", (_TN, _TF), "float32", 1),
+        ],
+        "segment_sum_sorted": [
+            ("ids", (_TE, 1), "int32", 2),
+            ("data", (_TE, _TF), "float32", 2),
+            ("out", (_TN, _TF), "float32", 1),
+        ],
+        "gather_rows": [
+            ("ids", (_TE, 1), "int32", 2),
+            ("table", (_TN, _TF), "float32", 2),
+            ("out", (_TE, _TF), "float32", 1),
+        ],
+        "gather_rows_sorted": [
+            ("ids", (_TE, 1), "int32", 2),
+            ("table", (_TN, _TF), "float32", 2),
+            ("out", (_TE, _TF), "float32", 1),
+        ],
+        # the fused kernel keeps the FULL-HEIGHT message block resident
+        # across every node tile and band step (grid order f, n, e) — the
+        # one block here whose footprint grows with the bucket, and the
+        # reason the budget check exists
+        "sage_fused": [
+            ("band_ptrs", (4, max(n_pad // _TN, 1)), "int32", 1),
+            ("ids+weights", (4 * _TE, 1), "int32", 2),
+            ("msg", (n_pad, _TF), "float32", 2),
+            ("out", (_TN, _TF), "float32", 1),
+            ("scratch", (_TE, _TF), "float32", 1),
+        ],
+    }
+
+
+def tile_constants() -> dict:
+    """The kernel tile sizes, exported for the deep pass's divisibility
+    check (lane dim 128, f32 sublane 8 — docs/kernel-paths.md)."""
+    return {"TN": _TN, "TE": _TE, "TF": _TF}
+
+
 # --- registration ------------------------------------------------------------
 
 

@@ -134,6 +134,37 @@ def make_sharded_train_step(model: NerrfNet, cfg: "TrainConfig", mesh: Mesh,
                                 program="train_step_sharded", extra=extra)
 
 
+def sharding_contract(mesh: Mesh) -> list:
+    """Declared sharding layout of the pjit shims in this module, as
+    ``(program, array, PartitionSpec, ndim)`` tuples — built from the SAME
+    `batch_sharding`/`replicated`/`stream_shardings` calls the real steps
+    use, so the contract can never drift from the code.
+
+    The deep static pass (`nerrf lint --deep`, collective-consistency)
+    validates every spec's axis names against the mesh and its rank
+    against the array it annotates: the pre-flight the pod-scale serving
+    work needs, run abstractly on CPU instead of at GSPMD partitioning
+    time on a pod."""
+    from nerrf_tpu.train.data import DatasetConfig, sample_spec
+
+    contract = []
+    b_spec = batch_sharding(mesh).spec
+    r_spec = replicated(mesh).spec
+    for k, (shape, _dtype) in sample_spec(DatasetConfig()).items():
+        contract.append(
+            ("train_step_sharded", f"batch[{k}]", b_spec, len(shape) + 1))
+    contract.append(("train_step_sharded", "rng", r_spec, 1))
+    # the stream batch layout the ring path consumes (train_sharded_stream
+    # builds exactly these three [B,T,...] arrays); a key stream_shardings
+    # grows beyond this map still gets its axis names validated — ndim
+    # falls back to the spec's own rank rather than crashing the rule
+    stream_ndim = {"feat": 3, "mask": 2, "label": 2}
+    for k, sh in stream_shardings(mesh).items():
+        contract.append(("stream_train_step", k, sh.spec,
+                         stream_ndim.get(k, len(tuple(sh.spec)))))
+    return contract
+
+
 # --- long-context stream training (dp × sp) ----------------------------------
 
 

@@ -198,7 +198,7 @@ class OnlineDetectionService:
         contract survives the cache.  Fail-open: an executable that raises
         is dropped and the batch re-scored through jit — an executable
         problem costs one compile, never a window."""
-        sig = _batch_signature(batch)
+        sig = batch_signature(batch)
         staged = self._compiled.get(sig)
         if staged is not None:
             exe, tag = staged
@@ -343,17 +343,7 @@ class OnlineDetectionService:
         next boot.  Every staged program then scores the shape-donor batch
         once, which both proves the executable runs on this device and
         keeps the no-cache jit path's warmup semantics unchanged."""
-        tiny = _tiny_trace("serve-warmup")
-        for bucket in self.cfg.buckets:
-            ds_cfg = self.cfg.dataset_config(bucket)
-            samples = windows_of_trace(tiny, ds_cfg)
-            if not samples:
-                continue
-            s0 = samples[0]
-            batch = {k: np.broadcast_to(
-                v, (self.cfg.batch_size,) + v.shape).copy()
-                for k, v in s0.items()}
-            tag = bucket_tag(bucket)
+        for bucket, tag, batch in warmup_batches(self.cfg):
             t0 = time.perf_counter()
             self.warmup_source[tag] = self._stage_program(tag, batch)
             self._score_fn(batch)
@@ -382,7 +372,7 @@ class OnlineDetectionService:
             program=f"serve_eval[{tag}]",
             extra=serve_program_key(self.model_config, tag))
         if fn is not self._eval_fn:
-            self._compiled[_batch_signature(batch)] = (fn, tag)
+            self._compiled[batch_signature(batch)] = (fn, tag)
         return info.source
 
     def stage_executables(self, exe_dir) -> None:
@@ -769,10 +759,30 @@ class OnlineDetectionService:
                                   ino_path=ino_path)
 
 
-def _batch_signature(batch: Dict[str, np.ndarray]) -> tuple:
+def warmup_batches(cfg: ServeConfig):
+    """Yield ``(bucket, tag, shape-donor batch)`` for every configured
+    bucket the warmup donor trace can fill — THE warmup-compiled set.
+    `_warmup` compiles exactly these batches; the deep static pass
+    (`nerrf lint --deep`, program-closure) re-derives the same set and
+    proves it equals the admission-reachable signature set, so a bucket
+    this generator silently skips (donor trace yields no sample) is a
+    statically provable first-live-window compile on the hot path."""
+    tiny = _tiny_trace("serve-warmup")
+    for bucket in cfg.buckets:
+        samples = windows_of_trace(tiny, cfg.dataset_config(bucket))
+        if not samples:
+            continue
+        batch = {k: np.broadcast_to(
+            v, (cfg.batch_size,) + v.shape).copy()
+            for k, v in samples[0].items()}
+        yield bucket, bucket_tag(bucket), batch
+
+
+def batch_signature(batch: Dict[str, np.ndarray]) -> tuple:
     """The scorer-side lookup key for a staged AOT executable: the padded
     batch's (name, shape, dtype) set — exactly what distinguishes one
-    bucket's program from another's at call time."""
+    bucket's program from another's at call time.  Also the signature the
+    deep pass compares warmup-compiled vs admission-reachable sets with."""
     return tuple(sorted(
         (k, tuple(v.shape), str(getattr(v, "dtype", type(v).__name__)))
         for k, v in batch.items()))

@@ -113,6 +113,42 @@ def window_sample(trace: Trace, lo: int, hi: int, cfg: DatasetConfig,
     return sample, stats
 
 
+def sample_spec(cfg: DatasetConfig) -> dict[str, tuple[tuple[int, ...], str]]:
+    """The static shape contract of `window_sample`: ``key → (shape, dtype)``
+    for every array a window lowered at ``cfg`` carries, derived from the
+    config alone — no trace, no lowering, no jax.
+
+    This is the shape authority the deep static pass (`nerrf lint --deep`,
+    nerrf_tpu/analysis/programs/) proves the serve ladder's signature
+    closure against: admission can only ever produce batches of these
+    shapes, so warmup compiling exactly these shapes IS the zero-recompile
+    contract.  `tests/test_programs.py` cross-checks it against a real
+    `window_sample` output so the two can never drift silently."""
+    from nerrf_tpu.data.sequences import SEQ_FEATURE_DIM
+    from nerrf_tpu.graph.builder import EDGE_FEATURE_DIM, NODE_FEATURE_DIM
+
+    n, e = cfg.graph.max_nodes, cfg.graph.max_edges
+    s, t = cfg.max_seqs, cfg.seq_len
+    return {
+        "node_feat": ((n, NODE_FEATURE_DIM), "float32"),
+        "node_type": ((n,), "int32"),
+        "node_aux": ((n,), "int32"),
+        "node_mask": ((n,), "bool"),
+        "node_key": ((n,), "int64"),
+        "node_label": ((n,), "float32"),
+        "edge_src": ((e,), "int32"),
+        "edge_dst": ((e,), "int32"),
+        "edge_feat": ((e, EDGE_FEATURE_DIM), "float32"),
+        "edge_mask": ((e,), "bool"),
+        "edge_label": ((e,), "float32"),
+        "seq_feat": ((s, t, SEQ_FEATURE_DIM), "float32"),
+        "seq_mask": ((s, t), "bool"),
+        "seq_label": ((s,), "float32"),
+        "seq_valid": ((s,), "bool"),
+        "seq_node_idx": ((s,), "int32"),
+    }
+
+
 def windows_of_trace(trace: Trace, cfg: DatasetConfig,
                      stats_out: Optional[list] = None) -> List[dict[str, np.ndarray]]:
     """All window samples for one trace.

@@ -47,6 +47,14 @@ cd "$(dirname "$0")/.."
 # instead of surfacing as a race/recompile mid-stream
 python scripts/nerrflint.py
 
+# pre-flight: the deep (jaxpr-level) program contracts — signature
+# closure of the serve ladder, donation discipline over the flat train
+# step, collective/sharding consistency, Pallas VMEM budgets, cache-key
+# coverage — proven abstractly on a virtual CPU backend (<30 s, no
+# devices; docs/static-analysis.md "The deep pass").  Same timeout guard
+# as the TPU queues: a wedged jax import must fail, not hang the e2e.
+timeout 120 python scripts/nerrflint.py --deep
+
 # pre-flight: the persistent compile cache must round-trip — warm one
 # serve bucket into a scratch cache (fresh compile, persisted), then
 # assert the second sweep DESERIALIZES it (source=cache for every

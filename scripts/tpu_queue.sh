@@ -30,6 +30,16 @@ if ! python scripts/nerrflint.py > /tmp/nerrflint.log 2>&1; then
   exit 1
 fi
 log "pre-flight: nerrflint clean"
+# pre-flight: the deep program contracts (zero-recompile closure of the
+# serve ladder, donation discipline, collective/sharding consistency,
+# Pallas VMEM budgets, cache-key coverage) proven on CPU via abstract
+# tracing — a contract break fails here in <30 s instead of hours into
+# chip work.  Runs BEFORE the tunnel wait: it needs no accelerator.
+if ! timeout 120 python scripts/nerrflint.py --deep > /tmp/nerrflint_deep.log 2>&1; then
+  log "PRE-FLIGHT FAIL: deep program-contract pass (/tmp/nerrflint_deep.log)"
+  exit 1
+fi
+log "pre-flight: deep program contracts verified (closure/donation/sharding/pallas/cache-key)"
 # the gate must exercise the full enumerate->compile->execute path: the
 # relay has been seen half-up (enumeration answering, remote_compile
 # refusing), which passes an enumeration-only check and then wedges the

@@ -23,6 +23,13 @@ if ! python scripts/nerrflint.py > /tmp/nerrflint.log 2>&1; then
   exit 1
 fi
 log "pre-flight: nerrflint clean"
+# same deep pre-flight as tpu_queue.sh: program contracts proven on CPU
+# (needs no accelerator, so it runs before the tunnel wait)
+if ! timeout 120 python scripts/nerrflint.py --deep > /tmp/nerrflint_deep.log 2>&1; then
+  log "PRE-FLIGHT FAIL: deep program-contract pass (/tmp/nerrflint_deep.log)"
+  exit 1
+fi
+log "pre-flight: deep program contracts verified (closure/donation/sharding/pallas/cache-key)"
 tpu_ok() {
   python -c "
 import sys

@@ -34,7 +34,7 @@ from nerrf_tpu.compilecache import (
     export_executables,
     read_manifest,
 )
-from nerrf_tpu.compilecache.cache import META, PAYLOAD, TREES, _aval_signature
+from nerrf_tpu.compilecache.cache import META, PAYLOAD, TREES, aval_signature
 from nerrf_tpu.flight.journal import EventJournal
 from nerrf_tpu.observability import MetricsRegistry
 
@@ -66,7 +66,7 @@ def test_fingerprint_invalidates_on_every_axis():
     donation spec, jax version, jaxlib version, device kind, device count,
     platform) produces a different fingerprint — the no-stale-reuse
     guarantee is structural, not probabilistic."""
-    avals = _aval_signature(_args(), {})
+    avals = aval_signature(_args(), {})
     env = {"jax": "0.4.30", "jaxlib": "0.4.30", "platform": "cpu",
            "device_kind": "cpu", "device_count": 1}
     extra = {"model": "JointConfig(hidden=32)", "donate": "(params,)"}
@@ -76,13 +76,13 @@ def test_fingerprint_invalidates_on_every_axis():
         ("program", compute_fingerprint("stream_step", avals, extra,
                                         env=env)[0]),
         ("arg shape", compute_fingerprint(
-            "train_step", _aval_signature(_args(8), {}), extra, env=env)[0]),
+            "train_step", aval_signature(_args(8), {}), extra, env=env)[0]),
         ("arg dtype", compute_fingerprint(
             "train_step",
-            _aval_signature((np.arange(4, dtype=np.float64),), {}),
+            aval_signature((np.arange(4, dtype=np.float64),), {}),
             extra, env=env)[0]),
         ("pytree layout", compute_fingerprint(
-            "train_step", _aval_signature(({"x": _args()[0]},), {}),
+            "train_step", aval_signature(({"x": _args()[0]},), {}),
             extra, env=env)[0]),
         ("architecture", compute_fingerprint(
             "train_step", avals,
