@@ -582,6 +582,38 @@ def main() -> None:
         return _smoke_or_artifact("serve", "run_serve_bench.py",
                                   "serve_bench_cpu.json", surface)
 
+    def _chaos():
+        # chaos soak: the serve path under the seeded fault schedule,
+        # surfaced by its survival gates (docs/chaos.md)
+        def surface(r):
+            return {
+                "streams": r.get("streams"),
+                "faults_injected": r.get("faults_injected"),
+                "all_faults_recovered": r.get("all_faults_recovered"),
+                "bisection_isolated_exactly_injected": r.get(
+                    "bisection", {}).get("isolated_exactly_injected"),
+                "quarantined_streams": r.get(
+                    "bisection", {}).get("quarantined_streams"),
+                "unfaulted_parity_bit_identical": r.get(
+                    "parity", {}).get("bit_identical_to_model_detect"),
+                "recompiles_after_warmup": r.get("recompiles_after_warmup"),
+                "reconnects": r.get("reconnects"),
+                "slo_worst_stream_p99_ms": (r.get("slo") or {}).get(
+                    "worst_stream_p99_ms"),
+                "slo_bounded": (r.get("slo") or {}).get("bounded"),
+                "flight_bundles": (r.get("flight") or {}).get("bundles"),
+                "disk_full_survived": (r.get("flight") or {}).get(
+                    "disk_full_survived"),
+                "cache_corruption_survived": r.get(
+                    "compile_cache_corruption", {}).get("survived"),
+                "backend": r.get("backend"),
+                "smoke": r.get("smoke"),
+                "provenance": r.get("provenance"),
+            }
+
+        return _smoke_or_artifact("chaos", "run_chaos_bench.py",
+                                  "chaos_bench_cpu.json", surface)
+
     def _swap():
         # model-lifecycle hot-swap: 2 streams, one mid-run swap + rollback
         def surface(r):
@@ -612,7 +644,8 @@ def main() -> None:
     # silently drop the valid artifacts after it
     for key, loader in (("corpus100h", _j100), ("adversarial", _adv),
                         ("m1_recovery", _recovery), ("tracker", _tracker),
-                        ("serve", _serve), ("model_swap", _swap)):
+                        ("serve", _serve), ("model_swap", _swap),
+                        ("chaos", _chaos)):
         try:
             entry = loader()
             if entry is not None:

@@ -1,0 +1,535 @@
+#!/usr/bin/env python3
+"""Chaos soak: the full serve path under a seeded fault schedule, gated
+on SURVIVAL.
+
+Every fail-open claim the serving plane makes is exercised here at once,
+under concurrent streams, by the chaos plane (nerrf_tpu/chaos,
+docs/chaos.md): wire errors mid-stream on a resident (follow-mode)
+tracker drain, per-window batch poison aimed at one stream, device
+latency spikes, a slow alert consumer, a bundle-volume ENOSPC, and a
+corrupted compile-cache payload at a warm boot.  The harness passes only
+if the system SURVIVES the schedule:
+
+  * no crash — every stream drain completes; unfaulted streams end
+    error-free;
+  * zero recompiles after warmup — poison-batch bisection re-pads to the
+    same batch shape, so isolation retries never mint a new program;
+  * bit-parity — an unfaulted stream's DetectionResult stays
+    bit-identical to offline `pipeline.model_detect` while chaos rages
+    in cohabiting streams (isolation, not just uptime);
+  * bisection isolated EXACTLY the poisoned windows — the set of
+    terminal `device_batch_failed` trace IDs equals the injected set,
+    and no unfaulted stream lost a single window to a shared batch;
+  * bounded SLO degradation — worst per-stream trailing p99 stays under
+    ``slo_limit`` (deadline ×6 by default);
+  * at least one flight bundle per drop burst — and the injected
+    ENOSPC on the first dump attempt is survived (rate-limit rollback
+    retries: a bundle still lands);
+  * every injected fault's journal record is matched to a recovery
+    record (per-site rules in `match_recoveries`).
+
+    python benchmarks/run_chaos_bench.py            # 6 streams + resident
+    python benchmarks/run_chaos_bench.py --smoke    # 3 streams, ~30 s
+    python benchmarks/run_chaos_bench.py --out results/chaos_bench_cpu.json
+
+Prints ONE JSON line (the artifact) on stdout; exits 1 when any survival
+gate fails.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+# the faulted streams: POISON_STREAM's windows carry seeded batch poison
+# (excluded from parity), WIRE_STREAM is the resident drain whose wire
+# errors exercise reconnect backoff.  Everything else must be untouched.
+POISON_STREAM = "s1"
+WIRE_STREAM = "w0"
+
+
+def build_plan(smoke: bool, wire_target: str):
+    """The fault schedule of record.  Seeded: the same plan + the same
+    simulated traces fire the same faults on every run.  ``wire_target``
+    aims the wire faults at the resident drain's endpoint only (sessions
+    rename w0 → w0#n, so the stable endpoint address is the aim point)."""
+    from nerrf_tpu import chaos
+
+    return chaos.FaultPlan(seed=42, faults=(
+        # per-window poison aimed at one stream (keyed by trace ID, so
+        # bisection retries see the same poison and isolation converges)
+        chaos.FaultSpec(site="serve.poison_window", prob=0.4,
+                        match={"stream": POISON_STREAM}),
+        # wire resets on the resident stream: every 5th frame the gRPC
+        # stream dies mid-session → finalize partial + reconnect
+        chaos.FaultSpec(site="ingest.wire_error", every=5,
+                        match={"target": wire_target}),
+        # device latency spikes: every 4th batch stalls (SLO pressure,
+        # but far under the watchdog's scorer_wedge_sec)
+        chaos.FaultSpec(site="serve.device_latency", mode="stall",
+                        every=4, delay_sec=0.1 if smoke else 0.2),
+        # a slow operator console draining alerts, once
+        chaos.FaultSpec(site="alerts.slow_consumer", mode="stall",
+                        at=1, delay_sec=0.3),
+        # the bundle volume is full for the FIRST dump attempt only: the
+        # recorder must fail open and retry into a real bundle
+        chaos.FaultSpec(site="flight.disk_full", at=1, max_fires=1),
+    ))
+
+
+def match_recoveries(records) -> dict:
+    """Join every injected fault to its observed recovery evidence in the
+    same journal.  Per-site rules:
+
+      serve.poison_window      → a terminal ``device_batch_failed`` record
+                                 with the SAME trace ID (bisection isolated
+                                 it; cohabitants scored);
+      ingest.wire_error        → a later ``reconnect`` record for the
+                                 stream (the final session's error has no
+                                 reconnect — the service was stopping — so
+                                 one unmatched firing is allowed);
+      serve.device_latency     → scoring continued: any batch_close /
+                                 readiness / chaos_disarmed record with a
+                                 greater journal seq (the stall ended and
+                                 the scorer did not wedge);
+      alerts.slow_consumer     → same rule (the stall is consumer-side);
+      flight.disk_full         → a later ``bundle`` record (the rate-limit
+                                 rollback retried into a real dump).
+    """
+    by_kind: dict = {}
+    for r in records:
+        by_kind.setdefault(r.kind, []).append(r)
+    fault_recs = by_kind.get("fault_injected", [])
+
+    def later_progress(seq):
+        return any(r.seq > seq for k in ("batch_close", "readiness",
+                                         "chaos_disarmed")
+                   for r in by_kind.get(k, []))
+
+    out = {}
+    for rec in fault_recs:
+        site = rec.data.get("site")
+        entry = out.setdefault(site, {"injected": 0, "recovered": 0,
+                                      "unmatched": []})
+        entry["injected"] += 1
+        ok = False
+        if site == "serve.poison_window":
+            ok = any(d.trace_id == rec.trace_id
+                     for d in by_kind.get("device_batch_failed", []))
+        elif site == "ingest.wire_error":
+            ok = any(r.seq > rec.seq for r in by_kind.get("reconnect", []))
+        elif site == "flight.disk_full":
+            ok = any(r.seq > rec.seq for r in by_kind.get("bundle", []))
+        elif site in ("serve.device_latency", "alerts.slow_consumer",
+                      "ingest.wire_stall", "serve.device_error"):
+            ok = later_progress(rec.seq)
+        elif site == "compilecache.corrupt_payload":
+            ok = any(r.seq > rec.seq and r.data.get("source")
+                     in ("fresh", "live")
+                     for r in by_kind.get("compile", []))
+        if ok:
+            entry["recovered"] += 1
+        else:
+            entry["unmatched"].append(
+                {"seq": rec.seq, "trace_id": rec.trace_id,
+                 "stream": rec.stream})
+    # the final wire-error session has no reconnect (service stopping):
+    # one trailing unmatched firing is expected, not a survival failure
+    wire = out.get("ingest.wire_error")
+    if wire and len(wire["unmatched"]) == 1 \
+            and wire["unmatched"][0]["seq"] == max(
+                (r.seq for r in fault_recs
+                 if r.data.get("site") == "ingest.wire_error"), default=-1):
+        wire["recovered"] += 1
+        wire["final_session_allowance"] = wire["unmatched"].pop()
+    out["all_recovered"] = all(
+        v["recovered"] >= v["injected"] for k, v in out.items()
+        if isinstance(v, dict))
+    return out
+
+
+def drop_bursts(records, n: int, window_sec: float) -> int:
+    """Count distinct drop bursts in the journal (≥ n loss records inside
+    a sliding window) — the ground truth the bundles-per-burst gate joins
+    against.  Consecutive over-threshold windows collapse into one burst."""
+    from nerrf_tpu.flight.recorder import DROP_KINDS
+
+    times = sorted(r.t_perf for r in records if r.kind in DROP_KINDS)
+    bursts, i, last_end = 0, 0, None
+    for j in range(len(times)):
+        while times[j] - times[i] > window_sec:
+            i += 1
+        if j - i + 1 >= n:
+            if last_end is None or times[i] > last_end:
+                bursts += 1
+            last_end = times[j]
+    return bursts
+
+
+def run(streams: int = 6, sim_seconds: float = 45.0,
+        bucket=(256, 512, 128), batch_size: int = 8,
+        close_ms: float = 250.0, smoke: bool = False,
+        log=lambda *a: print(*a, file=sys.stderr, flush=True)) -> dict:
+    """Importable harness body (tier-1's chaos smoke calls this
+    in-process).  Returns the artifact dict."""
+    if smoke:
+        streams, sim_seconds = 3, 25.0
+    log = log or (lambda *a: None)
+    import shutil
+    import tempfile
+
+    import jax
+
+    from nerrf_tpu import chaos
+    from nerrf_tpu.compilecache import CompileCache
+    from nerrf_tpu.data.loaders import Trace
+    from nerrf_tpu.data.synth import SimConfig, simulate_trace
+    from nerrf_tpu.flight import FlightConfig, FlightRecorder
+    from nerrf_tpu.flight.journal import EventJournal
+    from nerrf_tpu.ingest.service import TraceReplayServer, TrackerClient
+    from nerrf_tpu.models import JointConfig, NerrfNet
+    from nerrf_tpu.observability import MetricsRegistry
+    from nerrf_tpu.pipeline import model_detect
+    from nerrf_tpu.serve import (
+        OnlineDetectionService,
+        ServeConfig,
+        bucket_tag,
+        init_untrained_params,
+    )
+
+    backend = jax.default_backend()
+    deadline_sec = 2.0
+    cfg = ServeConfig(
+        buckets=(tuple(bucket),), batch_size=batch_size,
+        batch_close_sec=close_ms / 1000.0,
+        window_sec=15.0, stride_sec=5.0,
+        # a deliberately tiny alert sink: with no consumer draining
+        # mid-run, scored-window alerts evict continuously (counted
+        # demux_drop records) — the steady loss signal the drop-burst
+        # trigger and the injected first-dump ENOSPC retry feed on
+        stream_queue_slots=512, alert_queue_slots=2,
+        window_deadline_sec=deadline_sec,
+        # survival knobs under test: bisection on, quarantine reachable
+        # within a smoke run, watchdog far above the injected stalls
+        bisect_failed_batches=True, quarantine_strikes=16,
+        scorer_wedge_sec=60.0)
+    model = NerrfNet(JointConfig().small)
+    params = init_untrained_params(model, cfg)
+    registry = MetricsRegistry(namespace="chaosbench")
+    journal = EventJournal(capacity=16384, registry=registry)
+    window_log: list = []
+    svc = OnlineDetectionService(params, model, cfg=cfg, registry=registry,
+                                 window_log=window_log, journal=journal)
+    t0 = time.perf_counter()
+    svc.start(log=log)
+    warmup_wall = round(time.perf_counter() - t0, 2)
+    log(f"[chaos-bench] warmup {warmup_wall}s")
+
+    # flight recorder: the drop-burst trigger is the one under test (the
+    # injected ENOSPC hits its first dump) — thresholds sized so the
+    # schedule's induced losses form at least one burst
+    flight_dir = tempfile.mkdtemp(prefix="nerrf-chaos-flight-")
+    burst_n, burst_sec = 3, 30.0
+    recorder = FlightRecorder(
+        FlightConfig(out_dir=flight_dir, p99_breach_sec=None,
+                     drop_burst_n=burst_n, drop_burst_sec=burst_sec,
+                     min_interval_sec=300.0),
+        registry=registry, journal=journal, slo=svc.slo,
+        info=svc.flight_info, log=log)
+    svc.attach_flight(recorder)
+
+    # one replay server per stream + one for the resident (follow) drain
+    traces, servers, targets = [], [], []
+    for i in range(streams):
+        tr = simulate_trace(SimConfig(
+            duration_sec=sim_seconds, attack=(i % 2 == 0),
+            attack_start_sec=sim_seconds / 3, num_target_files=4,
+            benign_rate_hz=6.0, seed=2000 + 131 * i))
+        srv = TraceReplayServer(tr.events, tr.strings, batch_size=64)
+        srv.start()
+        traces.append(tr)
+        servers.append(srv)
+        targets.append(f"127.0.0.1:{srv.port}")
+    wire_tr = simulate_trace(SimConfig(
+        duration_sec=sim_seconds / 2, attack=False, benign_rate_hz=6.0,
+        seed=9999))
+    wire_srv = TraceReplayServer(wire_tr.events, wire_tr.strings,
+                                 batch_size=32)  # small frames: several
+    wire_srv.start()                             # wire-fault chances/session
+    servers.append(wire_srv)
+    events_total = int(sum(tr.events.num_valid for tr in traces))
+
+    # ---- unfaulted baseline leg --------------------------------------------
+    # The SAME stream load with the chaos plane disarmed: its worst
+    # per-stream p99 is the reference the faulted leg's "bounded SLO
+    # degradation" gate compares against.  Replay is unpaced, so absolute
+    # latency tracks the rig's wall clock — only the RATIO is meaningful
+    base_reg = MetricsRegistry(namespace="chaosbase")
+    base_jrn = EventJournal(capacity=8192, registry=base_reg)
+    base_svc = OnlineDetectionService(params, model, cfg=cfg,
+                                      registry=base_reg, journal=base_jrn)
+    base_svc.start(log=log)
+    base_runs = [base_svc.connect(f"s{i}", targets[i], timeout=300.0)
+                 for i in range(streams)]
+    for r in base_runs:
+        r.done.wait(timeout=600.0)
+    base_svc.stop(drain=True)
+    base_snapshot = base_svc.slo.snapshot()
+    baseline_p99 = max((s.get("p99_ms") for s in
+                        (base_snapshot.get("per_stream") or {}).values()
+                        if s.get("p99_ms") is not None), default=None)
+    log(f"[chaos-bench] unfaulted baseline worst p99 {baseline_p99}ms")
+
+    # arm AFTER warmup (faults target steady-state serving, and warmup
+    # must stay deterministic for the zero-recompile accounting)
+    plan = build_plan(smoke, f"127.0.0.1:{wire_srv.port}")
+    ctl = chaos.arm(plan, registry=registry, journal=journal)
+    log(f"[chaos-bench] armed {len(plan.faults)} fault specs (seed "
+        f"{plan.seed})")
+
+    t0 = time.perf_counter()
+    try:
+        runs = [svc.connect(f"s{i}", targets[i], timeout=300.0)
+                for i in range(streams)]
+        wire_run = svc.connect(WIRE_STREAM, f"127.0.0.1:{wire_srv.port}",
+                               timeout=300.0, follow=True,
+                               reconnect_sec=0.05, reconnect_max_sec=1.0)
+        for r in runs:
+            r.done.wait(timeout=600.0)
+        # stop closes admission; the resident drain exits its session
+        svc.stop(drain=True)
+        wire_run.done.wait(timeout=60.0)
+    finally:
+        chaos.disarm()
+        recorder.close()
+        svc.stop(drain=False)
+    wall = time.perf_counter() - t0
+
+    # ---- parity on an unfaulted stream (chaos must not leak) ---------------
+    parity_stream = "s0" if POISON_STREAM != "s0" else "s2"
+    pidx = int(parity_stream[1:])
+    ref_events, ref_strings = TrackerClient(
+        targets[pidx]).stream(timeout=60.0)
+    offline = model_detect(
+        Trace(events=ref_events, strings=ref_strings, ground_truth=None,
+              labels=None, name=parity_stream),
+        params, model, ds_cfg=cfg.dataset_config(tuple(bucket)),
+        auto_capacity=False, batch_size=batch_size)
+    served = runs[pidx].result
+    parity = (
+        served is not None
+        and served.file_scores == offline.file_scores
+        and served.file_window_scores == offline.file_window_scores
+        and served.proc_scores == offline.proc_scores
+        and served.threshold == offline.threshold)
+    for srv in servers:
+        srv.stop()
+
+    # ---- survival accounting -----------------------------------------------
+    records = journal.tail()
+    tag = bucket_tag(tuple(bucket))
+    recompiles = int(registry.value("serve_recompiles_total",
+                                    labels={"bucket": tag}))
+    poisoned_keys = sorted({key for site, key, _ in ctl.fired
+                            if site == "serve.poison_window"})
+    failed_ids = sorted({r.trace_id for r in records
+                         if r.kind == "device_batch_failed"})
+    # any stream OTHER than the poison target losing a window to a failed
+    # device batch is an isolation failure — this is the list of guilty-
+    # by-cohabitation victims, which bisection exists to empty
+    foreign_failed = sorted({r.stream for r in records
+                             if r.kind == "device_batch_failed"
+                             and r.stream != POISON_STREAM})
+    recoveries = match_recoveries(records)
+    bursts = drop_bursts(records, burst_n, burst_sec)
+    bundles = sorted(p for p in os.listdir(flight_dir)
+                     if p.startswith("bundle-") and not p.endswith(".tmp"))
+    shutil.rmtree(flight_dir, ignore_errors=True)
+    slo_snapshot = svc.slo.snapshot()
+    # the degradation bound: injected stalls + bisection/confirm retries
+    # may blow the 2 s per-window deadline (that is the point), but the
+    # faulted leg's worst p99 must stay within ×4 of the unfaulted
+    # baseline's on the same load (floored at ×5 the deadline so a very
+    # fast baseline cannot make the gate impossibly tight).  ×4 not ×3:
+    # back-to-back CPU-rehearsal runs measured ×1.9–×3.05 on identical
+    # code — the rig's load noise spans ~±30%; the TPU artifact should
+    # tighten this toward ×2
+    slo_limit_ms = max(deadline_sec * 5 * 1e3,
+                       4.0 * baseline_p99 if baseline_p99 else 0.0)
+    worst_p99 = max((s.get("p99_ms") for s in
+                     (slo_snapshot.get("per_stream") or {}).values()
+                     if s.get("p99_ms") is not None), default=None)
+    errors = {r.stream: repr(r.error) for r in runs if r.error}
+
+    # ---- the warm-boot-with-corrupt-cache leg ------------------------------
+    # A fresh service boots through a cache whose payload bytes rot at
+    # read: fail-open must evict, compile live, and reach readiness —
+    # the recovery is the journaled repair compile
+    cache_dir = tempfile.mkdtemp(prefix="nerrf-chaos-aot-")
+    cache_leg = {"cold_sources": None, "corrupt_sources": None,
+                 "survived": False}
+    try:
+        cold_reg = MetricsRegistry(namespace="chaoscold")
+        cold_jrn = EventJournal(capacity=2048, registry=cold_reg)
+        cold_svc = OnlineDetectionService(
+            params, model, cfg=cfg, registry=cold_reg, journal=cold_jrn,
+            compile_cache=CompileCache(root=cache_dir, registry=cold_reg,
+                                       journal=cold_jrn, log=log))
+        cold_svc.start(log=log)
+        cold_svc.stop()
+        cache_leg["cold_sources"] = dict(cold_svc.warmup_source)
+        corrupt_reg = MetricsRegistry(namespace="chaoscorrupt")
+        corrupt_jrn = EventJournal(capacity=2048, registry=corrupt_reg)
+        ctl2 = chaos.arm(chaos.FaultPlan(seed=42, faults=(
+            chaos.FaultSpec(site="compilecache.corrupt_payload",
+                            mode="corrupt", at=1),)),
+            registry=corrupt_reg, journal=corrupt_jrn)
+        try:
+            corrupt_svc = OnlineDetectionService(
+                params, model, cfg=cfg, registry=corrupt_reg,
+                journal=corrupt_jrn,
+                compile_cache=CompileCache(root=cache_dir,
+                                           registry=corrupt_reg,
+                                           journal=corrupt_jrn, log=log))
+            corrupt_svc.start(log=log)
+            corrupt_svc.stop()
+        finally:
+            chaos.disarm()
+        cache_leg["corrupt_sources"] = dict(corrupt_svc.warmup_source)
+        rec2 = match_recoveries(corrupt_jrn.tail())
+        cache_leg["recoveries"] = {
+            k: v for k, v in rec2.items() if k != "all_recovered"}
+        # survived = the fault fired, readiness was reached anyway, and
+        # the repair compile is journaled (fail-open end to end)
+        cache_leg["survived"] = bool(
+            ctl2.fired
+            and set(cache_leg["corrupt_sources"]) ==
+            set(cache_leg["cold_sources"])
+            and rec2["all_recovered"])
+    finally:
+        shutil.rmtree(cache_dir, ignore_errors=True)
+
+    quarantined_streams = sorted({r.stream for r in records
+                                  if r.kind == "stream_quarantined"})
+    result = {
+        "metric": "chaos_survival",
+        "value": 1.0 if not errors else 0.0,
+        "unit": "survived fault schedule (1=yes)",
+        "backend": backend,
+        "smoke": smoke or None,
+        "streams": streams + 1,  # + the resident wire stream
+        "events_total": events_total,
+        "wall_seconds": round(wall, 2),
+        "warmup_seconds": warmup_wall,
+        "plan": plan.to_dict(),
+        "faults_injected": {
+            site: sum(1 for s, _, _ in ctl.fired if s == site)
+            for site in sorted({s for s, _, _ in ctl.fired})},
+        "recoveries": {k: v for k, v in recoveries.items()
+                       if k != "all_recovered"},
+        "all_faults_recovered": recoveries["all_recovered"],
+        "windows_scored": int(registry.value("serve_windows_scored_total")),
+        "recompiles_after_warmup": recompiles,
+        "bisection": {
+            "poisoned_windows_injected": poisoned_keys,
+            "windows_isolated": failed_ids,
+            "isolated_exactly_injected": failed_ids == poisoned_keys,
+            "bisections": int(registry.value(
+                "serve_poison_bisections_total", labels={"bucket": tag})),
+            "foreign_streams_failed": foreign_failed,
+            "quarantined_streams": quarantined_streams,
+        },
+        "reconnects": int(registry.value(
+            "serve_reconnects_total", labels={"stream": WIRE_STREAM})),
+        "slo": {"worst_stream_p99_ms": worst_p99,
+                "baseline_unfaulted_p99_ms": baseline_p99,
+                "degradation_x": (round(worst_p99 / baseline_p99, 2)
+                                  if worst_p99 and baseline_p99 else None),
+                "limit_ms": round(slo_limit_ms, 1),
+                "bounded": worst_p99 is not None
+                and worst_p99 <= slo_limit_ms},
+        "flight": {"bundles": len(bundles),
+                   "triggers": sorted(b.rsplit("-", 1)[-1]
+                                      for b in bundles),
+                   "drop_bursts_observed": bursts,
+                   "bundle_per_burst": bursts > 0 and len(bundles) >= 1,
+                   "disk_full_survived": any(
+                       site == "flight.disk_full"
+                       for site, _, _ in ctl.fired) and len(bundles) >= 1},
+        "compile_cache_corruption": cache_leg,
+        "parity": {"stream": parity_stream,
+                   "bit_identical_to_model_detect": bool(parity)},
+        "stream_errors": errors or None,
+        "provenance": "python benchmarks/run_chaos_bench.py"
+                      + (" --smoke" if smoke else ""),
+    }
+    return result
+
+
+def gates(result: dict) -> list:
+    """The survival gates, as (name, ok) pairs — shared by main() and the
+    tier-1 smoke so they can never drift."""
+    return [
+        ("no_crash", result["stream_errors"] is None),
+        ("zero_recompiles", result["recompiles_after_warmup"] == 0),
+        ("windows_scored", result["windows_scored"] > 0),
+        ("poison_injected", len(
+            result["bisection"]["poisoned_windows_injected"]) > 0),
+        ("bisection_isolated_exactly_injected",
+         result["bisection"]["isolated_exactly_injected"]),
+        ("unfaulted_streams_lost_nothing",
+         result["bisection"]["foreign_streams_failed"] == []),
+        ("unfaulted_parity_bit_identical",
+         result["parity"]["bit_identical_to_model_detect"]),
+        ("slo_bounded", result["slo"]["bounded"]),
+        ("reconnects_happened", result["reconnects"] > 0),
+        ("bundle_per_drop_burst", result["flight"]["bundle_per_burst"]),
+        ("disk_full_survived", result["flight"]["disk_full_survived"]),
+        ("all_faults_recovered", result["all_faults_recovered"]),
+        ("cache_corruption_survived",
+         result["compile_cache_corruption"]["survived"]),
+    ]
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--streams", type=int, default=6)
+    ap.add_argument("--seconds", type=float, default=45.0,
+                    help="simulated seconds of trace per stream")
+    ap.add_argument("--bucket", default="256x512x128", metavar="NxExS")
+    ap.add_argument("--batch-size", type=int, default=8)
+    ap.add_argument("--close-ms", type=float, default=250.0)
+    ap.add_argument("--smoke", action="store_true",
+                    help="3 streams + the resident drain, ~30 s")
+    ap.add_argument("--out", default=None, metavar="FILE",
+                    help="also write the artifact JSON here")
+    args = ap.parse_args(argv)
+
+    result = run(streams=args.streams, sim_seconds=args.seconds,
+                 bucket=tuple(int(x) for x in args.bucket.split("x")),
+                 batch_size=args.batch_size, close_ms=args.close_ms,
+                 smoke=args.smoke)
+    checks = gates(result)
+    result["gates"] = {name: ok for name, ok in checks}
+    line = json.dumps(result)
+    print(line)
+    if args.out:
+        os.makedirs(os.path.dirname(os.path.abspath(args.out)),
+                    exist_ok=True)
+        with open(args.out, "w") as f:
+            f.write(json.dumps(result, indent=2) + "\n")
+    failed = [name for name, ok in checks if not ok]
+    if failed:
+        print(f"[chaos-bench] SURVIVAL GATES FAILED: {failed}",
+              file=sys.stderr)
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -64,6 +64,13 @@ REQUIRED = (
     "compile_cache_bytes_total",
     "compile_seconds",
     "serve_warmup_seconds",
+    # the chaos plane + its hardening (docs/chaos.md; the chaos bench's
+    # survival gates and the game-day runbook key off these exact names)
+    "chaos_faults_injected_total",
+    "serve_reconnects_total",
+    "serve_windows_quarantined_total",
+    "serve_poison_bisections_total",
+    "serve_scorer_wedged",
 )
 
 _CALL = re.compile(

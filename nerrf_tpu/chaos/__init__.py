@@ -1,0 +1,60 @@
+"""Chaos plane: deterministic, seedable fault injection for the paths
+whose failure handling the stack stakes SLO claims on.
+
+Every fail-open contract in the codebase (compile-cache corruption →
+live jit, registry veto → unstage, flight dump → rate-limit, batch
+failure → stream isolation) is proven here under *injected, repeatable*
+faults instead of only unit tests of the happy failure: named fault
+points threaded through the real ingest/serve/registry/cache/flight code
+paths (`chaos.points.SITES`), armed by a JSON `FaultPlan` (`nerrf chaos`,
+``NERRF_CHAOS_PLAN``), with every firing journaled as a ``fault_injected``
+record joinable to its observed effect by trace ID.  Disarmed points are
+a single global ``None`` check — free on the hot path.
+
+See docs/chaos.md for the site catalog, plan schema, and the game-day
+runbook; `benchmarks/run_chaos_bench.py` is the survival-gated soak.
+"""
+
+from nerrf_tpu.chaos.plan import (
+    ChaosFault,
+    FaultPlan,
+    FaultSpec,
+    corrupt_payload,
+    load_plan,
+)
+from nerrf_tpu.chaos.points import (
+    PLAN_ENV,
+    SITE_MODES,
+    SITES,
+    ChaosController,
+    arm,
+    arm_from_env,
+    armed,
+    check,
+    controller,
+    disarm,
+    inject,
+    mangle,
+    validate_plan,
+)
+
+__all__ = [
+    "PLAN_ENV",
+    "SITES",
+    "SITE_MODES",
+    "ChaosController",
+    "ChaosFault",
+    "FaultPlan",
+    "FaultSpec",
+    "arm",
+    "arm_from_env",
+    "armed",
+    "check",
+    "controller",
+    "corrupt_payload",
+    "disarm",
+    "inject",
+    "load_plan",
+    "mangle",
+    "validate_plan",
+]

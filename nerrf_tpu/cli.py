@@ -516,6 +516,50 @@ def cmd_lint(args) -> int:
 
 
 # --------------------------------------------------------------------------
+def cmd_chaos(args) -> int:
+    """Chaos plane (docs/chaos.md): the fault-point catalog, plan
+    validation, and an example schedule — the game-day front door.  A plan
+    is armed on a pod via ``NERRF_CHAOS_PLAN=<plan.json>`` (serve-detect
+    reads it at boot) or ``serve-detect --chaos-plan``; this subcommand
+    never arms anything itself.  No jax import — safe anywhere."""
+    from nerrf_tpu import chaos
+
+    if args.chaos_cmd == "sites":
+        rows = sorted(chaos.SITES.items())
+        if args.json:
+            print(json.dumps(dict(rows), indent=2))
+        else:
+            for site, desc in rows:
+                print(f"{site:<32} {desc}")
+        return 0
+    if args.chaos_cmd == "example":
+        plan = chaos.FaultPlan(seed=7, faults=(
+            chaos.FaultSpec(site="serve.poison_window", prob=0.05,
+                            match={"stream": "s1"}),
+            chaos.FaultSpec(site="ingest.wire_error", every=40),
+            chaos.FaultSpec(site="serve.device_latency", every=9,
+                            mode="stall", delay_sec=0.2,
+                            after_sec=5.0, for_sec=20.0),
+            chaos.FaultSpec(site="compilecache.corrupt_payload",
+                            mode="corrupt", at=1),
+        ))
+        print(json.dumps(plan.to_dict(), indent=2))
+        return 0
+    # validate
+    try:
+        plan = chaos.load_plan(args.plan)
+        chaos.validate_plan(plan)
+    except (OSError, ValueError, TypeError) as e:
+        _log(f"chaos plan {args.plan}: INVALID — {e}")
+        return 1
+    sites = sorted({s.site for s in plan.faults})
+    print(json.dumps({"plan": args.plan, "valid": True, "seed": plan.seed,
+                      "faults": len(plan.faults), "sites": sites},
+                     indent=2))
+    return 0
+
+
+# --------------------------------------------------------------------------
 def cmd_status(args) -> int:
     inc = Path(args.incident)
     stages = {
@@ -622,6 +666,25 @@ def cmd_serve_detect(args) -> int:
         cfg_kwargs["buckets"] = tuple(
             tuple(int(x) for x in b.split("x")) for b in args.buckets)
     cfg = ServeConfig(**cfg_kwargs)
+
+    # chaos plane (docs/chaos.md): arm a fault plan for a game day —
+    # --chaos-plan wins, else $NERRF_CHAOS_PLAN (one env var on the pod).
+    # Neither set → every fault point stays a free no-op.  A bad plan is
+    # a one-line refusal to boot (the operator asked for faults the pod
+    # cannot inject — serving WITHOUT them would fake the game day)
+    from nerrf_tpu import chaos
+
+    try:
+        if args.chaos_plan:
+            ctl = chaos.arm(chaos.load_plan(args.chaos_plan))
+            _log(f"chaos: armed {len(ctl.plan.faults)} fault spec(s) "
+                 f"from {args.chaos_plan} (seed {ctl.plan.seed})")
+        else:
+            chaos.arm_from_env(log=_log)
+    except (OSError, ValueError, TypeError) as e:
+        _log(f"chaos plan INVALID — {e} "
+             f"(check it with `nerrf chaos validate`)")
+        return 2
 
     compile_cache = None
     if not args.no_aot_cache:
@@ -1131,7 +1194,30 @@ def main(argv=None) -> int:
     p.add_argument("--trace-out", default=None, metavar="FILE",
                    help="write a Chrome-trace JSON of the serve session's "
                         "host spans on exit")
+    p.add_argument("--chaos-plan", default=None, metavar="FILE",
+                   help="arm a chaos fault plan for this run (game day: "
+                        "seeded fault injection at the named points, every "
+                        "firing journaled; docs/chaos.md).  Default: "
+                        "$NERRF_CHAOS_PLAN when set, else disarmed")
     p.set_defaults(fn=cmd_serve_detect)
+
+    p = sub.add_parser("chaos", help="chaos plane: fault-point catalog, "
+                                     "plan validation, example schedule "
+                                     "(docs/chaos.md)")
+    chsub = p.add_subparsers(dest="chaos_cmd", required=True)
+    chp = chsub.add_parser("sites", help="list every armed-able fault "
+                                         "point and what it simulates")
+    chp.add_argument("--json", action="store_true",
+                     help="machine-readable catalog")
+    chp.set_defaults(fn=cmd_chaos)
+    chp = chsub.add_parser("validate", help="parse + validate a plan "
+                                            "file; exit 1 when invalid")
+    chp.add_argument("plan", help="fault plan JSON "
+                                  "(see `nerrf chaos example`)")
+    chp.set_defaults(fn=cmd_chaos)
+    chp = chsub.add_parser("example", help="print a commented-by-shape "
+                                           "example plan to stdout")
+    chp.set_defaults(fn=cmd_chaos)
 
     p = sub.add_parser("cache", help="persistent compile cache: list, "
                                      "prune, verify, pre-warm")

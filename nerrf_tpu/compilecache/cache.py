@@ -259,7 +259,15 @@ class CompileCache:
         try:
             from jax.experimental import serialize_executable as se
 
-            payload = (entry / PAYLOAD).read_bytes()
+            from nerrf_tpu import chaos
+
+            # chaos fault point (no-op disarmed): bit rot / torn write in
+            # the entry payload — deserialize must fail here and take the
+            # evict-and-compile-live fail-open path below, never serve a
+            # damaged executable
+            payload = chaos.mangle(
+                "compilecache.corrupt_payload",
+                (entry / PAYLOAD).read_bytes(), key=fingerprint)
             in_tree, out_tree = pickle.loads((entry / TREES).read_bytes())
             compiled = se.deserialize_and_load(payload, in_tree, out_tree)
         except Exception as e:  # noqa: BLE001 — fail-open by contract

@@ -12,11 +12,22 @@ Record kinds in use (producers in parentheses):
 
     batch_close       a bucket's shared batch assembled (serve/batcher)
     batch_failed      a device batch's scoring raised (serve/batcher)
+    batch_bisect      a failed batch split to isolate poison (serve/batcher)
+    device_batch_failed  a window's terminal device failure, post-bisection
+                      (serve/service; counted by the drop-burst trigger)
+    stream_quarantined   a stream hit its poison-strike limit (serve/service)
+    stream_released   a quarantined stream's timed release (serve/service)
+    scorer_wedged     the scorer watchdog tripped / recovered
+    scorer_recovered  (serve/batcher; readiness fails while wedged)
+    reconnect         a resident stream's wire session restarted, with
+                      backoff delay (serve/service)
     admission_drop    window dropped at admission, with reason (serve/service)
     demux_drop        alert evicted from the full sink (serve/alerts)
     readiness         admission opened/closed (serve/service)
     config            serve config fingerprint at start (serve/service)
     slo_breach        a window blew its e2e deadline (flight/slo)
+    fault_injected    a chaos-plane fault fired at an armed point (chaos)
+    chaos_armed/disarmed  the chaos plane's arm state changed (chaos)
     registry_publish  a checkpoint became an immutable version (registry/store)
     registry_shadow   candidate staged for shadow scoring (registry/manager)
     registry_promote  candidate promoted to LIVE (registry/manager)

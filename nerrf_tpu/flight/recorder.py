@@ -52,7 +52,11 @@ from typing import Dict, Optional
 from nerrf_tpu.flight.journal import DEFAULT_JOURNAL, JournalRecord
 from nerrf_tpu.flight.slo import percentile
 
-DROP_KINDS = ("admission_drop", "demux_drop")
+# the loss-record kinds the drop-burst trigger counts: admission drops,
+# demuxed-alert evictions, AND device-batch failures — a persistent
+# device fault sheds windows exactly like overload does, and must leave
+# a bundle behind the same way
+DROP_KINDS = ("admission_drop", "demux_drop", "device_batch_failed")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -238,6 +242,12 @@ class FlightRecorder:
             return self._dump_locked(trigger, reason, context)
 
     def _dump_locked(self, trigger: str, reason: str, context: dict) -> str:
+        from nerrf_tpu import chaos
+
+        # chaos fault point (no-op disarmed): the bundle volume filling up
+        # mid-dump — the caller's fail-open (trigger() rolls back the
+        # rate-limit stamp, no .tmp orphan) is what survives
+        chaos.inject("flight.disk_full", trigger=trigger)
         out_root = os.fspath(self.cfg.out_dir)
         os.makedirs(out_root, exist_ok=True)
         stamp = time.strftime("%Y%m%dT%H%M%S", time.gmtime())

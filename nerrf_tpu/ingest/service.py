@@ -286,12 +286,18 @@ class TrackerClient:
         self._bridge = bridge or IngestBridge()
 
     def iter_blocks(
-        self, max_events: Optional[int] = None, timeout: float = 30.0
+        self, max_events: Optional[int] = None, timeout: float = 30.0,
+        stream: Optional[str] = None,
     ) -> Iterator[tuple[EventArrays, StringTable]]:
         """Yield (block, string-table) per decoded frame as it arrives, so
         callers can persist incrementally — a dropped stream loses only the
         frame in flight, not the whole session.  The string table is the
-        bridge's cumulative view (ids stable for the client's lifetime)."""
+        bridge's cumulative view (ids stable for the client's lifetime).
+        ``stream`` is the caller's stream label, carried only into the
+        chaos fault-point context so an injected wire fault is joinable to
+        the stream it hit."""
+        from nerrf_tpu import chaos
+
         total = 0
         with grpc.insecure_channel(self._target) as channel:
             call = channel.unary_stream(
@@ -302,6 +308,13 @@ class TrackerClient:
             from nerrf_tpu.observability import DEFAULT_REGISTRY
 
             for frame in call:
+                # chaos fault points (no-ops while disarmed): a mid-stream
+                # wire reset / producer stall, exactly where a flaky
+                # tracker or a congested link would deliver one
+                chaos.inject("ingest.wire_stall", stream=stream,
+                             target=self._target)
+                chaos.inject("ingest.wire_error", stream=stream,
+                             target=self._target)
                 # one instrumentation point: the span dual-writes the
                 # stage_latency_seconds{stage="ingest_decode"} histogram,
                 # so the Prometheus series and the trace stay consistent

@@ -167,11 +167,17 @@ class ModelRegistry:
         validate_checkpoint_dir(src)
         import errno
 
+        from nerrf_tpu import chaos
+
         ldir = self.lineage_dir(lineage)
         ldir.mkdir(parents=True, exist_ok=True)
         tmp = ldir / f".publish.tmp-{os.getpid()}-{time.monotonic_ns()}"
         try:
             shutil.copytree(src, tmp)
+            # chaos fault point (no-op disarmed): the store volume failing
+            # mid-publish — the BaseException sweep below must leave no
+            # tmp dir and no partial version behind
+            chaos.inject("registry.store_io", lineage=lineage)
             if executables is not None:
                 exe = Path(executables).absolute()
                 if not (exe / "manifest.json").is_file():
@@ -191,7 +197,12 @@ class ModelRegistry:
             meta["published_from"] = source or str(src)
             if (tmp / "executables" / "manifest.json").is_file():
                 meta["executables"] = "executables/"
-            sidecar.write_text(json.dumps(meta, indent=2))
+            # chaos fault point (no-op disarmed): a torn/bit-rotted
+            # sidecar in the published copy — every later load must fail
+            # with the one-line corrupt-sidecar error, never a traceback
+            sidecar.write_bytes(chaos.mangle(
+                "registry.corrupt_sidecar",
+                json.dumps(meta, indent=2).encode(), lineage=lineage))
             while True:
                 version = (max(self.versions(lineage), default=0)) + 1
                 try:

@@ -36,6 +36,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from nerrf_tpu import chaos
 from nerrf_tpu.flight.journal import DEFAULT_JOURNAL
 from nerrf_tpu.serve.config import Bucket, ServeConfig, bucket_tag
 from nerrf_tpu.tracing import span as trace_span
@@ -62,6 +63,12 @@ class WindowRequest:
     # set (under the batcher lock) when assembled into a closing batch:
     # an in-flight request can no longer be dropped, only awaited
     inflight: bool = False
+    # set by the batcher before on_failed when the failure is PROVEN
+    # window-specific: bisection pinned it to this single window while a
+    # sibling from the same original batch scored.  An all-fail batch
+    # (device-wide fault) or an unbisected cohort never sets it — only
+    # poison-proven windows strike their stream toward quarantine
+    poison: bool = False
     # flight/SLO plane: the window's journal/span join key, plus the
     # per-stage event-time stamps (admit → packed → scorer pickup) the
     # SLO tracker turns into budget-burn attribution
@@ -136,6 +143,14 @@ class MicroBatcher:
         self._ready: "queue.Queue" = queue.Queue()
         self._running = False
         self._threads: List[threading.Thread] = []
+        # scorer watchdog state (all under _lock): when one device call
+        # has been stuck past cfg.scorer_wedge_sec the batcher is WEDGED —
+        # readiness fails and leave() stops waiting, instead of every
+        # stream hanging on a dead scorer thread.  Cleared the moment the
+        # stuck call returns (journaled both ways).
+        self._scoring_since: Optional[float] = None
+        self._scoring_bucket: Optional[str] = None
+        self._wedged = False
 
     # -- submission (stream threads) -----------------------------------------
 
@@ -176,6 +191,16 @@ class MicroBatcher:
     @property
     def running(self) -> bool:
         return self._running
+
+    @property
+    def wedged(self) -> bool:
+        with self._lock:
+            return self._wedged
+
+    @property
+    def healthy(self) -> bool:
+        """Running and not wedged — what readiness and leave() key off."""
+        return self._running and not self.wedged
 
     # -- batch close ----------------------------------------------------------
 
@@ -266,13 +291,74 @@ class MicroBatcher:
                 help="device batches scored at a bucket shape not compiled "
                      "during warmup (steady state must stay at 0)")
             self.mark_warm(bucket)
+        failures: List[Tuple[List[WindowRequest], BaseException]] = []
+        scored_n = self._score_cohort(bucket, tag, reqs, 0, failures)
+        for f_reqs, exc in failures:
+            # poison evidence needs ALL of: pinned to a single window,
+            # a sibling from the same original batch scored (an all-fail
+            # batch, or a lone occupancy-1 deadline batch, indicts the
+            # device and strikes nobody), AND the window fails a CONFIRM
+            # re-run — one failed retry on an intermittently-failing
+            # device proves nothing about the window's stream
+            if scored_n > 0 and len(f_reqs) == 1 \
+                    and self._cfg.bisect_failed_batches:
+                confirm: List[Tuple[List[WindowRequest],
+                                    BaseException]] = []
+                if self._score_cohort(bucket, tag, f_reqs, 0, confirm):
+                    scored_n += 1  # intermittent fault: window delivered
+                    continue
+                for c_reqs, c_exc in confirm:
+                    for r in c_reqs:
+                        r.poison = True  # failed twice, siblings scored
+                    self._on_failed(c_reqs, c_exc)
+                continue
+            self._on_failed(f_reqs, exc)
+
+    def _score_cohort(self, bucket: Bucket, tag: str,
+                      reqs: List[WindowRequest], depth: int,
+                      failures: List[Tuple[List[WindowRequest],
+                                           BaseException]]) -> int:
+        """Score one cohort; on failure, bisect to isolate the poison.
+        Returns how many windows SCORED; terminal failures are appended
+        to ``failures`` (delivered by `_score_batch` once the whole
+        original batch's outcome — the poison evidence — is known).
+
+        A shared batch means one poisoned window (NaN-ing the program, or
+        a genuine device fault its data provokes) used to cost every
+        cohabiting stream's windows in the batch.  Instead: split the
+        failed cohort in half and retry each half — retried cohorts
+        re-pad to the same ``batch_size`` shape, so retries reuse the
+        compiled program (zero-recompile contract intact) — until the
+        failure is pinned to single windows.  Every window that did NOT
+        provoke the fault scores normally.  Cost is logarithmic:
+        isolating one poison window in a batch of B re-runs the program
+        ~2·log2(B) times, only while failing."""
         batch = self._stack(reqs)
         t_device = time.perf_counter()
         for r in reqs:
             r.t_device = t_device  # SLO stage stamp: scorer pickup
+        # watchdog window: ONE device call (this cohort's), not the whole
+        # bisection recursion — each retry re-stamps, so a slow-but-
+        # progressing isolation can never be mistaken for a wedge
+        with self._lock:
+            self._scoring_since = t_device
+            self._scoring_bucket = tag
         try:
             with trace_span("serve_device_score", device=True, bucket=tag,
                             windows=len(reqs)):
+                # chaos fault points (no-ops disarmed): a whole-batch
+                # device fault / latency spike, and the per-window poison
+                # (keyed by trace ID so bisection retries fire the same
+                # way the first score did — that is what lets the split
+                # isolate exactly the injected window)
+                chaos.inject("serve.device_latency", bucket=tag,
+                             windows=len(reqs))
+                chaos.inject("serve.device_error", bucket=tag,
+                             windows=len(reqs))
+                for r in reqs:
+                    chaos.inject("serve.poison_window", key=r.trace_id,
+                                 stream=r.stream, window_idx=r.window_idx,
+                                 bucket=tag)
                 out = self._score_fn(batch)
                 # a version-stamping score_fn (the registry-managed serve
                 # path) returns (probs, model_version); plain score_fns
@@ -286,11 +372,28 @@ class MicroBatcher:
                 "serve_batch_failures_total", labels={"bucket": tag},
                 help="device batches whose scoring raised")
             self._journal.record(
-                "batch_failed", bucket=tag, windows=len(reqs),
+                "batch_failed", bucket=tag, windows=len(reqs), depth=depth,
                 error=f"{type(exc).__name__}: {exc}",
                 trace_ids=[r.trace_id for r in reqs if r.trace_id])
-            self._on_failed(reqs, exc)
-            return
+            if len(reqs) > 1 and self._cfg.bisect_failed_batches:
+                self._reg.counter_inc(
+                    "serve_poison_bisections_total", labels={"bucket": tag},
+                    help="failed shared batches split-and-retried to "
+                         "isolate the poisoning window")
+                self._journal.record(
+                    "batch_bisect", bucket=tag, windows=len(reqs),
+                    depth=depth,
+                    trace_ids=[r.trace_id for r in reqs if r.trace_id])
+                mid = len(reqs) // 2
+                return (self._score_cohort(bucket, tag, reqs[:mid],
+                                           depth + 1, failures)
+                        + self._score_cohort(bucket, tag, reqs[mid:],
+                                             depth + 1, failures))
+            failures.append((list(reqs), exc))
+            return 0
+        finally:
+            with self._lock:
+                self._scoring_since = None
         now = time.perf_counter()
         scored: List[ScoredWindow] = []
         with trace_span("serve_demux", bucket=tag, windows=len(reqs)):
@@ -319,6 +422,7 @@ class MicroBatcher:
                 "serve_windows_scored_total", len(reqs),
                 help="windows scored through shared device batches")
             self._on_scored(scored)
+        return len(reqs)
 
     # -- threads --------------------------------------------------------------
 
@@ -327,8 +431,41 @@ class MicroBatcher:
         while self._running:
             self._kick.wait(timeout=tick)
             self._kick.clear()
-            for bucket, reqs, cause in self._collect_ready(time.perf_counter()):
+            now = time.perf_counter()
+            self._check_watchdog(now)
+            for bucket, reqs, cause in self._collect_ready(now):
                 self._emit_batch(bucket, reqs, cause)
+
+    def _check_watchdog(self, now: float) -> None:
+        """The closer thread doubles as the scorer's watchdog (it ticks on
+        its own clock even when no batches close): one device call stuck
+        past ``scorer_wedge_sec`` flips the batcher WEDGED — readiness
+        fails (a probe can restart the pod) and `leave()` stops waiting —
+        and the flip back is journaled the moment the call returns."""
+        limit = self._cfg.scorer_wedge_sec
+        if not limit:
+            return
+        with self._lock:
+            since, bucket = self._scoring_since, self._scoring_bucket
+            stuck = since is not None and now - since > limit
+            flipped = None
+            if stuck and not self._wedged:
+                self._wedged = True
+                flipped = ("scorer_wedged",
+                           {"bucket": bucket,
+                            "stuck_seconds": round(now - since, 2),
+                            "limit_seconds": limit})
+            elif self._wedged and not stuck:
+                self._wedged = False
+                flipped = ("scorer_recovered", {"bucket": bucket})
+        if flipped is not None:
+            kind, data = flipped
+            self._reg.gauge_set(
+                "serve_scorer_wedged", 1.0 if kind == "scorer_wedged"
+                else 0.0,
+                help="1 while a device call has been stuck past the "
+                     "watchdog limit (readiness fails while set)")
+            self._journal.record(kind, **data)
 
     def _score_loop(self) -> None:
         while True:
@@ -348,6 +485,12 @@ class MicroBatcher:
         if self._running:
             return
         self._running = True
+        # the wedge gauge must EXIST on a healthy pod — an alert on
+        # serve_scorer_wedged == 1 has to read 0, not "no data"
+        self._reg.gauge_set(
+            "serve_scorer_wedged", 0.0,
+            help="1 while a device call has been stuck past the "
+                 "watchdog limit (readiness fails while set)")
         self._threads = [
             threading.Thread(target=self._close_loop,
                              name="nerrf-serve-closer", daemon=True),

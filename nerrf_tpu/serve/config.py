@@ -73,6 +73,27 @@ class ServeConfig:
     threshold: Optional[float] = None
     # compile every configured bucket at start() (readiness gates on it)
     warmup_on_start: bool = True
+    # poison-batch bisection: a failed shared batch is split-and-retried
+    # to isolate the offending window(s) instead of dropping every
+    # cohabiting stream's windows; 0 disables (whole cohort fails, the
+    # pre-bisection behavior)
+    bisect_failed_batches: bool = True
+    # quarantine: after this many of one stream's windows are PROVEN
+    # batch poison (bisection pinned the failure to the window while a
+    # sibling scored — an all-fail batch indicts the device, not a
+    # stream), the stream itself is quarantined — admission drops its
+    # windows (reason="quarantined") so it cannot keep burning device
+    # retries for everyone else; 0 disables stream quarantine
+    quarantine_strikes: int = 8
+    # a quarantined stream is released (strikes reset, journaled) after
+    # this long — an upstream fix must not need a pod restart to take
+    # effect; 0 makes quarantine permanent for the stream's lifetime
+    quarantine_release_sec: float = 300.0
+    # scorer watchdog: a single device call stuck longer than this marks
+    # the batcher wedged — readiness fails (probes can restart the pod)
+    # and leave() stops waiting, instead of every stream hanging on a
+    # dead scorer thread; 0 disables
+    scorer_wedge_sec: float = 60.0
 
     @property
     def occupancy(self) -> int:

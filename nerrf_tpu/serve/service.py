@@ -142,6 +142,15 @@ class OnlineDetectionService:
             journal=self._journal)
         self._lock = threading.Lock()
         self._streams: Dict[str, StreamHandle] = {}
+        # poison accounting (under _lock): per-stream strike counters fed
+        # by PROVEN batch-poison windows (bisection isolated the window
+        # while a sibling scored), and stream → quarantined-at monotonic
+        # stamp for streams past cfg.quarantine_strikes — admission drops
+        # a quarantined stream's windows (until quarantine_release_sec
+        # passes) so it cannot keep burning device retries for every
+        # cohabiting stream
+        self._strikes: Dict[str, int] = {}
+        self._quarantined: Dict[str, float] = {}
         self._warm = False
         self._admission_open = False
         self.warmup_seconds: Dict[str, float] = {}
@@ -427,6 +436,12 @@ class OnlineDetectionService:
             return False, "warmup in progress", extra
         if not self._admission_open:
             return False, "admission closed", extra
+        if self._batcher.wedged:
+            # the scorer watchdog tripped: a device call has been stuck
+            # past cfg.scorer_wedge_sec.  Failing readiness here is the
+            # recovery path — the probe takes the pod out of rotation and
+            # restarts it, instead of every stream's leave() hanging
+            return False, "scorer wedged (device call stuck)", extra
         return True, "ok", extra
 
     def stop(self, drain: bool = True) -> None:
@@ -478,9 +493,11 @@ class OnlineDetectionService:
                 self._admit(handle, idx, lo, hi)
         deadline = time.monotonic() + timeout
         with handle.cond:
-            # a stopped batcher scores nothing more — waiting the full
-            # timeout on its queue would just stall every leaving stream
-            while handle.live and self._batcher.running:
+            # a stopped OR WEDGED batcher scores nothing more — waiting
+            # the full timeout on its queue would just stall every
+            # leaving stream (healthy = running and the scorer watchdog
+            # has not tripped; re-checked each 0.25 s wait slice)
+            while handle.live and self._batcher.healthy:
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     break
@@ -515,7 +532,8 @@ class OnlineDetectionService:
                 max_events: Optional[int] = None,
                 timeout: float = 30.0,
                 follow: bool = False,
-                reconnect_sec: float = 2.0) -> StreamRun:
+                reconnect_sec: float = 2.0,
+                reconnect_max_sec: float = 30.0) -> StreamRun:
         """Drain a live Tracker endpoint as one stream (join → feed per
         decoded block → leave at end-of-stream), on its own actor thread.
 
@@ -525,7 +543,18 @@ class OnlineDetectionService:
         (DetectionResult in ``run.result``) and the actor reconnects as
         ``<stream_id>#<n>``, forever, until the service stops admitting.
         Without it a 'resident' deployment would exit at the first stream
-        end and crash-loop through the warmup sweep."""
+        end and crash-loop through the warmup sweep.
+
+        Reconnect pacing is capped exponential backoff with jitter from
+        ``reconnect_sec`` up to ``reconnect_max_sec``: a session that
+        never produced a block doubles the delay (a dead endpoint is not
+        hammered, and the jitter de-synchronizes a fleet reconnecting to
+        one recovered tracker), while a session that fed at least one
+        block resets to the base (a live-but-flaky wire reconnects
+        promptly).  Every reconnect is journaled and counted into
+        ``nerrf_serve_reconnects_total{stream}``."""
+        import random
+
         from nerrf_tpu.ingest.service import TrackerClient
 
         done = threading.Event()
@@ -533,18 +562,22 @@ class OnlineDetectionService:
 
         def drain() -> None:
             session = 0
+            backoff = max(reconnect_sec, 0.001)
             try:
                 while True:
                     sid = stream_id if session == 0 \
                         else f"{stream_id}#{session}"
                     joined = False
+                    blocks = 0
                     try:
                         self.join(sid)
                         joined = True
                         client = TrackerClient(target)
                         for events, strings in client.iter_blocks(
-                                max_events=max_events, timeout=timeout):
+                                max_events=max_events, timeout=timeout,
+                                stream=sid):
                             self.feed(sid, events, strings)
+                            blocks += 1
                         run.result = self.leave(sid)
                         run.error = None
                     except BaseException as e:  # noqa: BLE001 — via run.error
@@ -560,7 +593,37 @@ class OnlineDetectionService:
                     if not (follow and self._admission_open):
                         return
                     session += 1
-                    time.sleep(reconnect_sec)
+                    # healthy = the wire produced data this session: reset
+                    # to the base; a dead endpoint (0 blocks) backs off
+                    if blocks > 0:
+                        backoff = max(reconnect_sec, 0.001)
+                    delay = backoff * (0.5 + random.random() / 2.0)
+                    if blocks == 0:
+                        backoff = min(backoff * 2.0, reconnect_max_sec)
+                    self._reg.counter_inc(
+                        "serve_reconnects_total",
+                        labels={"stream": stream_id},
+                        help="resident-stream wire reconnects (the "
+                             "follow-mode session restarts)")
+                    self._journal.record(
+                        "reconnect", stream=stream_id, session=session,
+                        healthy=blocks > 0, delay_sec=round(delay, 3),
+                        error=(f"{type(run.error).__name__}: {run.error}"
+                               if run.error is not None else None))
+                    # interruptible sleep: a stopping service must not
+                    # hold the actor for a full capped backoff
+                    deadline = time.monotonic() + delay
+                    while self._admission_open:
+                        left = deadline - time.monotonic()
+                        if left <= 0:
+                            break
+                        time.sleep(min(0.25, left))
+                    if not self._admission_open:
+                        # stopped mid-backoff: exit WITHOUT attempting
+                        # another join — it would raise "not admitting"
+                        # and overwrite run.error on a session that
+                        # finalized cleanly
+                        return
             finally:
                 done.set()
 
@@ -594,6 +657,45 @@ class OnlineDetectionService:
                 self._journal.record(
                     "admission_drop", stream=handle.id, window_id=idx,
                     trace_id=trace_id, reason="closed")
+                return
+            released = False
+            base = _base_stream(handle.id)
+            with self._lock:
+                q_at = self._quarantined.get(base)
+                if q_at is not None and self.cfg.quarantine_release_sec \
+                        and time.monotonic() - q_at \
+                        >= self.cfg.quarantine_release_sec:
+                    # timed release: an upstream fix must not need a pod
+                    # restart — the stream gets a clean slate (and earns
+                    # quarantine again in quarantine_strikes windows if
+                    # it is still poisonous)
+                    del self._quarantined[base]
+                    self._strikes[base] = 0
+                    q_at = None
+                    released = True
+            if released:
+                self._journal.record("stream_released", stream=base,
+                                     after_sec=self.cfg
+                                     .quarantine_release_sec)
+                # the gauge must clear with the ledger, or a released
+                # stream reads as permanently at the threshold
+                self._reg.gauge_set(
+                    "serve_stream_strikes", 0.0, labels={"stream": base},
+                    help="proven poison windows charged against a "
+                         "stream (quarantined at quarantine_strikes)")
+            if q_at is not None:
+                # the stream earned cfg.quarantine_strikes proven
+                # poison windows: its traffic is shed at admission so it
+                # cannot keep provoking device faults (and bisection
+                # retries) against every cohabiting stream
+                handle.dropped += 1
+                self._reg.counter_inc(
+                    "serve_admission_dropped_total",
+                    labels={"reason": "quarantined"},
+                    help="windows dropped at the serve admission boundary")
+                self._journal.record(
+                    "admission_drop", stream=handle.id, window_id=idx,
+                    trace_id=trace_id, reason="quarantined")
                 return
             # measure/lower from the window's slice of the stream, not the
             # whole accumulated history — O(window) admission, not
@@ -716,18 +818,71 @@ class OnlineDetectionService:
                 model_version=s.model_version, trace_id=s.trace_id))
 
     def _on_failed(self, reqs: List[WindowRequest], exc: BaseException) -> None:
+        """Terminal failure for a cohort the batcher could not score.
+        Each window is journaled as ``device_batch_failed`` with its
+        trace ID — the drop-burst flight trigger counts these, so a
+        persistent device fault dumps a bundle instead of failing
+        silently.  Windows the batcher marked ``poison`` (bisection
+        pinned the failure to the window while a sibling scored) strike
+        their stream toward quarantine; an all-fail batch or an
+        unbisected cohort indicts the device and blames no stream."""
+        reason = type(exc).__name__
         for r in reqs:
             with self._lock:
                 handle = self._streams.get(r.stream)
-            if handle is None:
-                continue
-            with handle.cond:
-                handle.live.pop(r.window_idx, None)
-                handle.failed += 1
-                handle.cond.notify_all()
+            if handle is not None:
+                with handle.cond:
+                    handle.live.pop(r.window_idx, None)
+                    handle.failed += 1
+                    handle.cond.notify_all()
+            # strike/metric key: the BASE stream name — a resident
+            # (follow-mode) stream renames per session (s0, s0#1, …), and
+            # per-session keys would both reset its strikes on every
+            # reconnect (quarantine evasion) and mint an unbounded label
+            # series on a long-lived pod (serve_reconnects_total already
+            # uses the base name for the same reason)
+            base = _base_stream(r.stream)
             self._reg.counter_inc(
                 "serve_windows_failed_total",
-                help="windows lost to a failed device batch")
+                labels={"reason": reason, "stream": base},
+                help="windows lost to a failed device batch, by failure "
+                     "type and stream")
+            strikes = None
+            newly_quarantined = False
+            if r.poison and self.cfg.quarantine_strikes:
+                with self._lock:
+                    strikes = self._strikes.get(base, 0) + 1
+                    self._strikes[base] = strikes
+                    if strikes >= self.cfg.quarantine_strikes \
+                            and base not in self._quarantined:
+                        self._quarantined[base] = time.monotonic()
+                        newly_quarantined = True
+                self._reg.counter_inc(
+                    "serve_windows_quarantined_total",
+                    labels={"stream": base},
+                    help="windows isolated as batch poison by bisection "
+                         "and dropped (cohabiting windows scored)")
+                self._reg.gauge_set(
+                    "serve_stream_strikes", float(strikes),
+                    labels={"stream": base},
+                    help="proven poison windows charged against a "
+                         "stream (quarantined at quarantine_strikes)")
+            # journal OUTSIDE handle.cond/self._lock (same contract as
+            # _admit: the flight recorder may dump a bundle on this
+            # record — drop-burst counts device_batch_failed).  The
+            # record keeps the SESSION id (evidence names the exact
+            # wire session); the strike ledger is base-keyed
+            self._journal.record(
+                "device_batch_failed", stream=r.stream,
+                window_id=r.window_idx, trace_id=r.trace_id,
+                reason=f"{reason}: {exc}", poison=r.poison,
+                **({"strikes": strikes} if strikes is not None else {}))
+            if newly_quarantined:
+                self._journal.record(
+                    "stream_quarantined", stream=base,
+                    strikes=strikes,
+                    limit=self.cfg.quarantine_strikes,
+                    release_sec=self.cfg.quarantine_release_sec)
 
     # -- finalize -------------------------------------------------------------
 
@@ -757,6 +912,15 @@ class OnlineDetectionService:
                                   threshold=self.cfg.threshold,
                                   detector=detector,
                                   ino_path=ino_path)
+
+
+def _base_stream(stream_id: str) -> str:
+    """The stable stream name under session renames: connect(follow=True)
+    drains sessions as <name>, <name>#1, <name>#2, … — strike ledgers,
+    quarantine state and per-stream metric labels all key on the base so
+    a wire reconnect is neither a clean slate for a poisonous stream nor
+    a fresh label series on every session."""
+    return stream_id.split("#", 1)[0]
 
 
 def warmup_batches(cfg: ServeConfig):

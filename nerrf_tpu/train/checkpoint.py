@@ -89,6 +89,13 @@ def _read_sidecar(path: Path, name: str) -> dict:
     except json.JSONDecodeError as e:
         raise ValueError(
             f"corrupt checkpoint sidecar {f}: not valid JSON ({e})") from None
+    except UnicodeDecodeError as e:
+        # bit rot rarely respects UTF-8 boundaries: a mangled byte inside
+        # a multi-byte sequence fails DECODE before json ever parses —
+        # same corruption class, same one-line error
+        raise ValueError(
+            f"corrupt checkpoint sidecar {f}: not valid UTF-8 ({e})"
+        ) from None
 
 
 def _check_schema_version(meta: dict, path: Path) -> None:

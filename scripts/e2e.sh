@@ -67,6 +67,16 @@ NERRF_AOT_CACHE_DIR="$WORK/aot" python -m nerrf_tpu.cli cache warm \
     --no-probe --buckets 64x128x32 --expect-cache > "$WORK/cache_warm.json"
 echo "e2e: compile cache round-trips (second sweep source=cache)"
 
+# pre-flight: chaos smoke — the serve path survives a short seeded fault
+# schedule (window poison → bisection isolates exactly it, wire resets →
+# backoff reconnect, ENOSPC'd bundle dump → retried, corrupt cache
+# payload → fail-open recompile) with zero recompiles and unfaulted-
+# stream bit-parity.  Exit 1 = a survival gate regressed (docs/chaos.md).
+# Pinned to CPU: this must run (and fail fast) on a tunnel-wedged host.
+timeout 560 env JAX_PLATFORMS=cpu python benchmarks/run_chaos_bench.py \
+    --smoke > "$WORK/chaos_smoke.json"
+echo "e2e: chaos smoke survival gates pass"
+
 if [ "$MODE" = "live" ]; then
     make -C native build/nerrf-trackerd >/dev/null
     rc=0

@@ -40,6 +40,18 @@ if ! timeout 120 python scripts/nerrflint.py --deep > /tmp/nerrflint_deep.log 2>
   exit 1
 fi
 log "pre-flight: deep program contracts verified (closure/donation/sharding/pallas/cache-key)"
+# pre-flight: chaos smoke on CPU — the serve path survives the seeded
+# fault schedule (poison bisection, backoff reconnect, ENOSPC'd dump
+# retry, corrupt-cache fail-open) with zero recompiles and unfaulted
+# bit-parity (docs/chaos.md).  Needs no accelerator, so it runs BEFORE
+# the tunnel wait: a survival regression fails here, not mid-queue.
+if ! timeout 560 env JAX_PLATFORMS=cpu python benchmarks/run_chaos_bench.py \
+  --smoke > /tmp/chaos_smoke.json 2>> /tmp/tpu_queue.log
+then
+  log "PRE-FLIGHT FAIL: chaos smoke survival gates (/tmp/chaos_smoke.json)"
+  exit 1
+fi
+log "pre-flight: chaos smoke survival gates pass"
 # the gate must exercise the full enumerate->compile->execute path: the
 # relay has been seen half-up (enumeration answering, remote_compile
 # refusing), which passes an enumeration-only check and then wedges the

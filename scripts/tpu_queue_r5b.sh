@@ -30,6 +30,15 @@ if ! timeout 120 python scripts/nerrflint.py --deep > /tmp/nerrflint_deep.log 2>
   exit 1
 fi
 log "pre-flight: deep program contracts verified (closure/donation/sharding/pallas/cache-key)"
+# same chaos pre-flight as tpu_queue.sh: survival gates proven on CPU
+# before any tunnel time is spent (docs/chaos.md)
+if ! timeout 560 env JAX_PLATFORMS=cpu python benchmarks/run_chaos_bench.py \
+  --smoke > /tmp/chaos_smoke.json 2>> /tmp/tpu_queue.log
+then
+  log "PRE-FLIGHT FAIL: chaos smoke survival gates (/tmp/chaos_smoke.json)"
+  exit 1
+fi
+log "pre-flight: chaos smoke survival gates pass"
 tpu_ok() {
   python -c "
 import sys

@@ -49,3 +49,48 @@ import pytest  # noqa: E402
 @pytest.fixture(scope="session")
 def repo_root() -> pathlib.Path:
     return pathlib.Path(__file__).resolve().parent.parent
+
+
+def make_service_shell(cfg, registry=None, journal=None):
+    """The private-state skeleton the fake-service tests build
+    `OnlineDetectionService` from (no model, no compile): every field the
+    admission / demux / failure / lifecycle paths touch, EXCEPT the
+    batcher — each caller wires its own score_fn and starts it.  ONE
+    copy: a field added to __init__ is added here once, not in three
+    hand-rolled constructors (test_serve / test_registry / test_chaos)."""
+    import threading
+
+    from nerrf_tpu.flight.journal import EventJournal
+    from nerrf_tpu.flight.slo import SLOTracker
+    from nerrf_tpu.observability import MetricsRegistry
+    from nerrf_tpu.serve.alerts import AlertSink
+    from nerrf_tpu.serve.service import OnlineDetectionService
+
+    registry = registry or MetricsRegistry(namespace="test")
+    svc = OnlineDetectionService.__new__(OnlineDetectionService)
+    svc.cfg = cfg
+    svc._params = None
+    svc._model = None
+    svc._reg = registry
+    svc._journal = journal if journal is not None \
+        else EventJournal(registry=registry)
+    svc._slo = SLOTracker(cfg.window_deadline_sec, registry=registry,
+                          journal=svc._journal)
+    svc._flight = None
+    svc._manager = None
+    svc._live_version = None
+    svc._shadow = None
+    svc._boot_threshold = cfg.threshold
+    svc.sink = AlertSink(cfg.alert_queue_slots, registry=registry,
+                         journal=svc._journal)
+    svc._lock = threading.Lock()
+    svc._swap_lock = threading.Lock()
+    svc._streams = {}
+    svc._strikes = {}
+    svc._quarantined = {}
+    svc._warm = True
+    svc._admission_open = False
+    svc.warmup_seconds = {}
+    svc.warmup_source = {}
+    svc._window_log = None
+    return svc, registry

@@ -28,7 +28,7 @@ from nerrf_tpu.registry import (
     evaluate,
     make_stats,
 )
-from nerrf_tpu.serve import MicroBatcher, OnlineDetectionService, ServeConfig
+from nerrf_tpu.serve import MicroBatcher, ServeConfig
 
 BUCKET = (256, 512, 64)
 
@@ -291,27 +291,11 @@ def _fake_swap_service(cfg, registry):
     like the real _score_fn does (captured once per batch under the swap
     lock) — covers swap atomicity, version stamping, and rollback without
     compiling anything."""
-    svc = OnlineDetectionService.__new__(OnlineDetectionService)
-    svc.cfg = cfg
-    svc._params = _leaf_params(0.25)
-    svc._model = None
-    svc._reg = registry
-    from nerrf_tpu.flight.journal import EventJournal
-    from nerrf_tpu.flight.slo import SLOTracker
-    from nerrf_tpu.serve.alerts import AlertSink
+    from conftest import make_service_shell
 
-    svc._journal = EventJournal(registry=registry)
-    svc._slo = SLOTracker(cfg.window_deadline_sec, registry=registry,
-                          journal=svc._journal)
-    svc._flight = None
-    svc.sink = AlertSink(cfg.alert_queue_slots, registry=registry,
-                         journal=svc._journal)
-    svc._swap_lock = threading.Lock()
+    svc, registry = make_service_shell(cfg, registry=registry)
+    svc._params = _leaf_params(0.25)
     svc._live_version = 1
-    svc._shadow = None
-    svc._manager = None
-    svc._window_log = None
-    svc._boot_threshold = cfg.threshold
 
     def score(batch):
         with svc._swap_lock:
@@ -335,11 +319,7 @@ def _fake_swap_service(cfg, registry):
                                 on_scored=svc._on_scored,
                                 on_failed=svc._on_failed,
                                 journal=svc._journal)
-    svc._lock = threading.Lock()
-    svc._streams = {}
-    svc._warm = True
     svc._admission_open = True
-    svc.warmup_seconds = {}
     for b in cfg.buckets:
         svc._batcher.mark_warm(b)
     svc._batcher.start()

@@ -46,36 +46,18 @@ def _sim(seed=3, duration=60.0, attack=True, files=6, rate=6.0):
 
 def _fake_service(cfg, registry=None, score=None, start=True):
     """A service whose device program is a stub: covers windowing,
-    admission, packing and demux without any compile."""
-    registry = registry or MetricsRegistry(namespace="test")
-    svc = OnlineDetectionService.__new__(OnlineDetectionService)
-    # minimal init without model/eval (the batcher only needs score_fn)
-    svc.cfg = cfg
-    svc._params = None
-    svc._model = None
-    svc._reg = registry
-    from nerrf_tpu.flight.journal import EventJournal
-    from nerrf_tpu.flight.slo import SLOTracker
-    from nerrf_tpu.serve.alerts import AlertSink
+    admission, packing and demux without any compile.  The private-state
+    skeleton lives in conftest.make_service_shell (one copy, shared with
+    test_registry/test_chaos); this wires the stub batcher onto it."""
+    from conftest import make_service_shell
 
-    svc._journal = EventJournal(registry=registry)
-    svc._slo = SLOTracker(cfg.window_deadline_sec, registry=registry,
-                          journal=svc._journal)
-    svc._flight = None
-    svc.sink = AlertSink(cfg.alert_queue_slots, registry=registry,
-                         journal=svc._journal)
+    svc, registry = make_service_shell(cfg, registry=registry)
     score = score or (lambda batch:
                       np.full(batch["node_mask"].shape, 0.9, np.float64))
     svc._batcher = MicroBatcher(score_fn=score, cfg=cfg, registry=registry,
                                 on_scored=svc._on_scored,
                                 on_failed=svc._on_failed,
                                 journal=svc._journal)
-    svc._lock = threading.Lock()
-    svc._streams = {}
-    svc._warm = True
-    svc._admission_open = False
-    svc.warmup_seconds = {}
-    svc._window_log = None
     for b in cfg.buckets:
         svc._batcher.mark_warm(b)
     if start:
@@ -175,6 +157,91 @@ def test_admission_closed_after_stop_drops_counted():
     det = svc.leave("s0", timeout=30.0)  # must NOT wait the 30 s
     assert time.perf_counter() - t0 < 5.0
     assert det.detector == "serve[max]"
+
+
+def test_connect_duplicate_id_join_failure_leaves_live_stream_alone():
+    """A second actor connecting under an id that is already joined must
+    record the join error on ITS run and never tear down the live stream
+    it lost the name race to (the drain only leaves streams it joined)."""
+    from nerrf_tpu.ingest.service import TraceReplayServer
+
+    cfg = ServeConfig(buckets=(BUCKET_B,), batch_size=4,
+                      batch_close_sec=0.02, window_sec=10.0, stride_sec=5.0)
+    svc, reg = _fake_service(cfg)
+    tr = _sim(seed=43, duration=40.0, files=3, rate=5.0)
+    server = TraceReplayServer(tr.events, tr.strings, batch_size=256)
+    port = server.start()
+    try:
+        svc.join("s0")  # the live stream another actor owns
+        svc.feed("s0", next(_blocks(tr, size=250)), tr.strings)
+        run = svc.connect("s0", f"127.0.0.1:{port}", timeout=10.0)
+        assert run.done.wait(timeout=10.0)
+        assert isinstance(run.error, ValueError)  # "already joined"
+        assert run.result is None
+        # the live stream survived and still works end to end
+        assert "s0" in svc._streams
+        for b in _blocks(tr, size=250):
+            svc.feed("s0", b, tr.strings)
+        det = svc.leave("s0", timeout=10.0)
+        assert det.detector == "serve[max]"
+    finally:
+        server.stop()
+        svc.stop(drain=False)
+
+
+def test_connect_drain_sets_done_even_when_leave_raises():
+    """The error path's cleanup leave() failing (scorer wedged, timeout,
+    anything) must still set run.done — a caller waiting on the drain can
+    never hang on a doubly-failed stream."""
+    cfg = ServeConfig(buckets=(BUCKET_B,), batch_size=4,
+                      batch_close_sec=0.02, window_sec=10.0, stride_sec=5.0)
+    svc, reg = _fake_service(cfg)
+
+    def exploding_leave(sid, flush=True, timeout=60.0):
+        raise RuntimeError("leave timed out / wedged")
+
+    svc.leave = exploding_leave
+    try:
+        # unroutable target: iter_blocks raises after join succeeded, the
+        # drain's cleanup leave() then raises too
+        run = svc.connect("s0", "127.0.0.1:1", timeout=2.0)
+        assert run.done.wait(timeout=30.0)
+        assert run.error is not None
+        assert run.result is None
+    finally:
+        svc.stop(drain=False)
+
+
+def test_stop_during_backoff_keeps_clean_sessions_error_free():
+    """stop() landing inside the reconnect backoff window must end the
+    drain WITHOUT one more join() attempt — the RuntimeError a closed
+    service raises would overwrite run.error on a stream whose last
+    session finalized cleanly."""
+    from nerrf_tpu.ingest.service import TraceReplayServer
+
+    cfg = ServeConfig(buckets=(BUCKET_B,), batch_size=4,
+                      batch_close_sec=0.02, window_sec=10.0, stride_sec=5.0)
+    svc, reg = _fake_service(cfg)
+    tr = _sim(seed=47, duration=40.0, files=3, rate=5.0)
+    server = TraceReplayServer(tr.events, tr.strings, batch_size=256)
+    port = server.start()
+    try:
+        # long base backoff: the actor is overwhelmingly likely to be
+        # inside the sleep when the stop lands
+        run = svc.connect("s0", f"127.0.0.1:{port}", timeout=30.0,
+                          follow=True, reconnect_sec=30.0)
+        deadline = time.perf_counter() + 30.0
+        while "s0" not in svc.sink.detections \
+                and time.perf_counter() < deadline:
+            time.sleep(0.05)
+        assert "s0" in svc.sink.detections  # first session finalized
+        svc.stop(drain=False)
+        assert run.done.wait(timeout=10.0)  # NOT a 30 s backoff later
+        assert run.error is None  # the clean session's verdict survived
+        assert run.result is not None
+    finally:
+        server.stop()
+        svc.stop(drain=False)
 
 
 def test_connect_follow_reconnects_sessions():
