@@ -236,7 +236,9 @@ def main() -> None:
     # a cross-check but is NOT the numerator — on TPU it costs matmuls at
     # their MXU-padded shapes (~3x high here, enough to put "MFU" at 195%).
     from nerrf_tpu.bench.mfu import flops_per_step, mfu
+    from nerrf_tpu.devtime import chip_peaks
 
+    chip = chip_peaks(jax.devices()[0])  # None off-chip: null, never fake
     super_flops = analytic_flops(train_step, state, rng)
     step_flops = super_flops / steps_per_call if super_flops else None
     xla_super_flops = flops_per_step(train_step, state, rng)
@@ -546,7 +548,19 @@ def main() -> None:
         # 2 streams, ~5 s of serving through the full wire path
         def surface(r):
             slo_streams = (r.get("slo") or {}).get("per_stream") or {}
+            devtime = r.get("devtime") or {}
             return {
+                # device-efficiency plane: per-bucket MFU (null on CPU by
+                # contract), useful-FLOPs fractions, headroom verdict
+                "device": {
+                    "programs": devtime.get("programs"),
+                    "useful_flops_fraction":
+                        devtime.get("useful_flops_fraction"),
+                    "util_fraction": devtime.get("util_fraction"),
+                    "headroom_prediction_within_band":
+                        (r.get("capacity") or {}).get(
+                            "prediction_within_band"),
+                } if devtime else None,
                 "streams": r.get("streams"),
                 "events_per_sec": r.get("value"),
                 "occupancy_mean": r.get("batch", {}).get("occupancy_mean"),
@@ -737,6 +751,33 @@ def main() -> None:
             "serve_warm_all_cache":
                 (artifacts.get("serve") or {}).get("compile_warm_all_cache"),
         } if compile_seconds or artifacts.get("serve") else None,
+        # device truth (nerrf_tpu/devtime): per-program analytic-vs-
+        # cost_analysis FLOPs and the serve path's per-bucket MFU — null
+        # on CPU rigs by contract (a fabricated MFU is the failure mode
+        # this block exists to prevent), so the first chip-side run
+        # fills the table with zero extra work
+        "device_truth": {
+            "flops_authority": "analytic jaxpr counters (bench/flops.py); "
+                               "cost_analysis recorded as cross-check only",
+            "train_step": {
+                "analytic_flops":
+                    round(step_flops) if step_flops else None,
+                "cost_analysis_flops":
+                    round(xla_step_flops) if xla_step_flops else None,
+                "cost_analysis_over_analytic":
+                    (round(xla_step_flops / step_flops, 2)
+                     if step_flops and xla_step_flops else None),
+                "mfu_pct": round(mfu_pct, 2) if mfu_pct else None,
+            },
+            "serve": (artifacts.get("serve") or {}).get("device"),
+            "chip": {
+                "device_kind": getattr(jax.devices()[0], "device_kind", ""),
+                "peak_tflops_bf16": chip.tflops_bf16 if chip else None,
+                "peak_hbm_gbps": chip.hbm_gbps if chip else None,
+                "ridge_flops_per_byte":
+                    round(chip.ridge_flops_per_byte, 1) if chip else None,
+            },
+        },
         "kernel_path": kernel_path,
         "stream_events_per_sec":
             round(stream_events_per_sec) if stream_events_per_sec else None,

@@ -71,6 +71,15 @@ REQUIRED = (
     "serve_windows_quarantined_total",
     "serve_poison_bisections_total",
     "serve_scorer_wedged",
+    # the device-efficiency plane (docs/device-efficiency.md; the serve
+    # bench's efficiency leg and the capacity-planning runbook key off
+    # these exact names — chip-relative ones are ABSENT off-chip by
+    # contract, but their call sites must stay registered)
+    "device_mfu",
+    "device_util_fraction",
+    "device_useful_flops_fraction",
+    "device_roofline_intensity",
+    "capacity_headroom_streams",
 )
 
 _CALL = re.compile(

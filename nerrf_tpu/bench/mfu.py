@@ -16,29 +16,11 @@ from __future__ import annotations
 
 from typing import Optional
 
-# bf16 peak TFLOP/s per chip, by device_kind substring (public specs).
-_PEAKS = (
-    ("v6 lite", 918.0),  # Trillium / v6e
-    ("v6e", 918.0),
-    ("v5 lite", 197.0),  # v5e
-    ("v5e", 197.0),
-    ("v5p", 459.0),
-    ("v5", 197.0),
-    ("v4", 275.0),
-    ("v3", 123.0),
-    ("v2", 45.0),
-)
-
-
-def chip_peak_tflops(device) -> Optional[float]:
-    """bf16 peak for a jax device, or None when unknown (e.g. CPU)."""
-    kind = getattr(device, "device_kind", "").lower()
-    if "tpu" not in kind and getattr(device, "platform", "") != "tpu":
-        return None
-    for pat, peak in _PEAKS:
-        if pat in kind:
-            return peak
-    return None
+# The peak table lives in nerrf_tpu/devtime/peaks.py now (exact-match-
+# first resolution + HBM bandwidth for the roofline gauges); this module
+# keeps its historical API as a thin delegate so every bench caller and
+# artifact script keeps working unchanged.
+from nerrf_tpu.devtime.peaks import chip_peak_tflops  # noqa: F401  (re-export)
 
 
 def flops_per_step(jit_fn, *args, **kwargs) -> Optional[float]:

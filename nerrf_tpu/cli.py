@@ -467,6 +467,215 @@ def cmd_warmup(args) -> int:
 
 
 # --------------------------------------------------------------------------
+def _profile_model(args, cfg):
+    """(params, model) for the profile subcommands: the checkpoint when
+    given, else the untrained small detector (shapes and programs are
+    what the cost/capture planes measure — weights don't matter)."""
+    from nerrf_tpu.models import JointConfig, NerrfNet
+    from nerrf_tpu.serve import init_untrained_params
+
+    if getattr(args, "model_dir", None):
+        from nerrf_tpu.train.checkpoint import load_checkpoint
+
+        params, model_cfg = load_checkpoint(args.model_dir)
+        return params, NerrfNet(model_cfg)
+    model = NerrfNet(JointConfig().small)
+    return init_untrained_params(model, cfg), model
+
+
+def _profile_serve_cfg(args):
+    from nerrf_tpu.serve import ServeConfig
+
+    if getattr(args, "smoke", False):
+        return ServeConfig(buckets=((64, 128, 32),))
+    if getattr(args, "buckets", None):
+        return ServeConfig(buckets=tuple(
+            tuple(int(x) for x in b.split("x")) for b in args.buckets))
+    return ServeConfig()
+
+
+def cmd_profile(args) -> int:
+    """Device-efficiency plane CLI (docs/device-efficiency.md):
+
+    ``costs``   — the per-program cost/MFU table: analytic FLOPs, byte
+    floor, roofline intensity for every serve bucket program + the flat
+    train step; ``--measure N`` times real calls so the same invocation
+    prints measured MFU on chip (null on CPU — never fabricated).
+    ``capture`` — a jax.profiler trace: drive the serve ladder locally
+    under the profiler, or pull from a live service started with
+    ``--profiler-port`` (when the environment ships the collect client).
+    """
+    if args.profile_cmd == "costs":
+        return _profile_costs(args)
+    return _profile_capture(args)
+
+
+def _profile_costs(args) -> int:
+    from nerrf_tpu.utils import enable_compilation_cache, ensure_backend_or_cpu
+
+    enable_compilation_cache()
+    if not args.no_probe:
+        ensure_backend_or_cpu("nerrf-profile", timeout_sec=75.0)
+    import jax
+    import numpy as np
+
+    from nerrf_tpu.devtime import chip_peaks, serve_program_costs
+    from nerrf_tpu.serve.service import warmup_batches
+    from nerrf_tpu.train.loop import make_eval_fn
+    from nerrf_tpu.utils import fetch_value
+
+    cfg = _profile_serve_cfg(args)
+    params, model = _profile_model(args, cfg)
+    eval_fn = make_eval_fn(model)
+    peaks = chip_peaks(jax.devices()[0])
+    costs = serve_program_costs(eval_fn, params, cfg,
+                                cross_check=args.cross_check)
+    rows = {}
+    for tag, cost in costs.items():
+        rows[cost.program] = {**cost.to_dict(), "measured": None}
+    if not args.no_train:
+        from nerrf_tpu.devtime import train_step_cost
+        from nerrf_tpu.serve.service import _tiny_trace
+        from nerrf_tpu.train.data import windows_of_trace
+        from nerrf_tpu.train.loop import TrainConfig
+
+        samples = windows_of_trace(
+            _tiny_trace("profile-costs"),
+            cfg.dataset_config(sorted(cfg.buckets)[0]))
+        if samples:
+            arrays = {k: np.stack([s[k] for s in samples])
+                      for k in samples[0]}
+            tc = train_step_cost(model, TrainConfig(model=model.cfg),
+                                 arrays, cross_check=args.cross_check)
+            if tc is not None:
+                rows[tc.program] = {**tc.to_dict(), "measured": None}
+    if args.measure > 0:
+        # real timed calls per bucket (compile excluded): the measured
+        # MFU column — the first chip-side run of this command IS the
+        # first non-null serve MFU number
+        for _bucket, tag, batch in warmup_batches(cfg):
+            program = f"serve_eval[{tag}]"
+            if program not in rows:
+                continue
+            fetch_value(eval_fn(params, batch)["node_logit"])  # compile
+            t0 = time.perf_counter()
+            for _ in range(args.measure):
+                # nerrflint: ok[sync-in-hot-loop] the sync IS the
+                # measurement (device seconds per call)
+                fetch_value(eval_fn(params, batch)["node_logit"])
+            per_call = (time.perf_counter() - t0) / args.measure
+            flops = rows[program]["flops"]
+            achieved = flops / per_call if per_call > 0 else None
+            rows[program]["measured"] = {
+                "seconds_per_call": round(per_call, 5),
+                "achieved_tflops":
+                    round(achieved / 1e12, 3) if achieved else None,
+                "mfu": (round(achieved / (peaks.tflops_bf16 * 1e12), 5)
+                        if achieved and peaks else None),
+            }
+    out = {
+        "backend": jax.default_backend(),
+        "device_kind": getattr(jax.devices()[0], "device_kind", ""),
+        "peaks": ({"kind": peaks.kind,
+                   "tflops_bf16": peaks.tflops_bf16,
+                   "hbm_gbps": peaks.hbm_gbps,
+                   "ridge_flops_per_byte":
+                       round(peaks.ridge_flops_per_byte, 1)}
+                  if peaks else None),
+        "flops_authority": "analytic jaxpr counters (bench/flops.py); "
+                           "cost_analysis recorded as cross-check only",
+        "programs": rows,
+    }
+    if args.json:
+        print(json.dumps(out, indent=2))
+        return 0
+    peak_s = (f"{peaks.tflops_bf16:g} TFLOP/s bf16, {peaks.hbm_gbps:g} GB/s"
+              if peaks else "unknown (no chip-relative numbers)")
+    print(f"device: {out['device_kind'] or out['backend']}  peak: {peak_s}")
+    print(f"{'program':<28} {'Gflops/call':>12} {'MB floor':>9} "
+          f"{'flops/B':>8} {'s/call':>8} {'MFU':>7}")
+    for name, r in sorted(rows.items()):
+        meas = r.get("measured") or {}
+        mfu = meas.get("mfu")
+        print(f"{name:<28} {r['flops'] / 1e9:>12.2f} "
+              f"{r['bytes_accessed'] / 1e6:>9.1f} "
+              f"{(r['intensity_flops_per_byte'] or 0):>8.1f} "
+              f"{meas.get('seconds_per_call', '-'):>8} "
+              f"{f'{mfu:.2%}' if mfu is not None else 'null':>7}")
+    return 0
+
+
+def _profile_capture(args) -> int:
+    from nerrf_tpu.devtime import profiled, trace_summary
+
+    if args.target:
+        # remote capture from a service started with --profiler-port.
+        # jax ships the collection client as jax.collect_profile, but it
+        # needs the tensorboard profiler plugin — gate, never half-work
+        try:
+            import jax.collect_profile as _cp
+        except Exception as e:  # noqa: BLE001 — gated optional dep
+            _log(f"remote capture unavailable in this environment "
+                 f"({type(e).__name__}: {e}); run `nerrf profile capture` "
+                 f"without --target for a local driven capture, or use "
+                 f"TensorBoard's profile plugin against the service's "
+                 f"--profiler-port")
+            return 2
+        host, _, port = args.target.rpartition(":")
+        try:
+            # tracer levels mirror jax.collect_profile's own CLI defaults
+            _cp.collect_profile(port=int(port),
+                                duration_in_ms=int(args.seconds * 1e3),
+                                host=host or "127.0.0.1", log_dir=args.out,
+                                host_tracer_level=2, device_tracer_level=1,
+                                python_tracer_level=1,
+                                no_perfetto_link=True)
+        except Exception as e:  # noqa: BLE001 — one-line failure, no trace
+            _log(f"remote capture from {args.target} failed: "
+                 f"{type(e).__name__}: {e}")
+            return 1
+        summary = trace_summary(args.out)
+        print(json.dumps({"trace_dir": args.out, **(summary or {})}))
+        return 0 if summary else 1
+    # local driven capture: score the serve ladder's donor batches under
+    # the profiler for --seconds, so the trace holds real device work
+    from nerrf_tpu.utils import enable_compilation_cache, ensure_backend_or_cpu
+
+    enable_compilation_cache()
+    if not args.no_probe:
+        ensure_backend_or_cpu("nerrf-profile", timeout_sec=75.0)
+    from nerrf_tpu.serve.service import warmup_batches
+    from nerrf_tpu.train.loop import make_eval_fn
+    from nerrf_tpu.utils import fetch_value
+
+    cfg = _profile_serve_cfg(args)
+    params, model = _profile_model(args, cfg)
+    eval_fn = make_eval_fn(model)
+    donors = [(tag, batch) for _b, tag, batch in warmup_batches(cfg)]
+    if not donors:
+        _log("no warmup donor batches for the configured ladder")
+        return 2
+    for _tag, batch in donors:  # compile OUTSIDE the capture window
+        fetch_value(eval_fn(params, batch)["node_logit"])
+    deadline = time.monotonic() + args.seconds
+    with profiled(args.out) as active:
+        if active is None:
+            _log("profiler could not start (see profile_failed journal "
+                 "record) — nothing captured")
+            return 1
+        while time.monotonic() < deadline:
+            for _tag, batch in donors:
+                # nerrflint: ok[sync-in-hot-loop] paced capture driver:
+                fetch_value(eval_fn(params, batch)["node_logit"])
+    summary = trace_summary(args.out)
+    print(json.dumps({"trace_dir": args.out, **(summary or {})}))
+    if summary:
+        _log(f"trace captured: {summary['files']} file(s) in {args.out} — "
+             f"load in Perfetto/TensorBoard")
+    return 0 if summary else 1
+
+
+# --------------------------------------------------------------------------
 def cmd_trace(args) -> int:
     """Offline inspector for ``--trace-out`` artifacts: per-stage latency
     table (count, total/mean/p50/max ms, % of wall) from a Chrome-trace
@@ -761,11 +970,23 @@ def cmd_serve_detect(args) -> int:
 
         recorder = FlightRecorder(
             FlightConfig(out_dir=args.flight_dir,
-                         p99_breach_sec=args.deadline_sec),
+                         p99_breach_sec=args.deadline_sec,
+                         profile_on_p99_sec=args.profile_on_breach_sec),
             info=service.flight_info, slo=service.slo, log=_log)
         service.attach_flight(recorder)
         uninstall_crash = install_crash_handlers(recorder)
-        _log(f"flight recorder armed: bundles in {args.flight_dir}")
+        _log(f"flight recorder armed: bundles in {args.flight_dir}"
+             + (f" (+{args.profile_on_breach_sec:g}s profiler trace per "
+                f"p99 breach)" if args.profile_on_breach_sec > 0 else ""))
+    _profiler_server = None
+    if args.profiler_port >= 0:
+        # profiler server: `nerrf profile capture --target` / TensorBoard
+        # pull traces from the live pod without touching the hot path.
+        # The handle must stay referenced for the server's lifetime
+        import jax
+
+        _profiler_server = jax.profiler.start_server(args.profiler_port)
+        _log(f"jax profiler server on :{args.profiler_port}")
     if manager is not None:
         manager.attach(service)
         manager.start_polling()
@@ -1199,6 +1420,16 @@ def main(argv=None) -> int:
                         "seeded fault injection at the named points, every "
                         "firing journaled; docs/chaos.md).  Default: "
                         "$NERRF_CHAOS_PLAN when set, else disarmed")
+    p.add_argument("--profiler-port", type=int, default=-1,
+                   help="start a jax.profiler server on this port so "
+                        "`nerrf profile capture --target` / TensorBoard "
+                        "can pull traces from the live service (-1 "
+                        "disables)")
+    p.add_argument("--profile-on-breach-sec", type=float, default=0.0,
+                   help="with --flight-dir: embed this many seconds of "
+                        "live jax.profiler trace into every p99-breach "
+                        "bundle (jax_trace/, summarized by `nerrf "
+                        "doctor`); 0 disables")
     p.set_defaults(fn=cmd_serve_detect)
 
     p = sub.add_parser("chaos", help="chaos plane: fault-point catalog, "
@@ -1263,6 +1494,59 @@ def main(argv=None) -> int:
                     help="exit 1 unless EVERY ladder bucket resolved "
                          "source=cache (the CI/queue pre-flight's second "
                          "sweep)")
+
+    p = sub.add_parser("profile", help="device-efficiency plane: per-"
+                                       "program cost/MFU table, jax "
+                                       "profiler capture "
+                                       "(docs/device-efficiency.md)")
+    psub = p.add_subparsers(dest="profile_cmd", required=True)
+    pp = psub.add_parser("costs", help="per-program cost table: analytic "
+                                       "FLOPs / byte floor / roofline "
+                                       "intensity for the serve ladder + "
+                                       "flat train step; --measure adds "
+                                       "timed calls → measured MFU (null "
+                                       "off-chip, never fabricated)")
+    pp.add_argument("--model-dir", default=None,
+                    help="checkpoint whose programs to cost (default: the "
+                         "untrained small detector — shapes are what "
+                         "matter)")
+    pp.add_argument("--buckets", nargs="*", default=None, metavar="NxExS",
+                    help="capacity-bucket ladder (default: the serve "
+                         "ladder)")
+    pp.add_argument("--smoke", action="store_true",
+                    help="one tiny bucket (CPU-pinned CI pre-flight)")
+    pp.add_argument("--measure", type=int, default=0, metavar="N",
+                    help="time N real calls per bucket after compile "
+                         "(the measured-MFU column; 0 = analytic only)")
+    pp.add_argument("--cross-check", action="store_true",
+                    help="also record XLA cost_analysis FLOPs/bytes per "
+                         "program (pays one compile each; recorded as "
+                         "cross-check, never the MFU numerator)")
+    pp.add_argument("--no-train", action="store_true",
+                    help="skip the flat train-step row")
+    pp.add_argument("--json", action="store_true")
+    pp.add_argument("--no-probe", action="store_true")
+    pp.set_defaults(fn=cmd_profile)
+    pp = psub.add_parser("capture", help="capture a jax.profiler trace "
+                                         "(Perfetto/TensorBoard readable): "
+                                         "drive the serve ladder locally, "
+                                         "or pull from a live service's "
+                                         "--profiler-port")
+    pp.add_argument("--out", required=True, metavar="DIR",
+                    help="trace output directory")
+    pp.add_argument("--seconds", type=float, default=3.0,
+                    help="capture duration")
+    pp.add_argument("--target", default=None, metavar="HOST:PORT",
+                    help="live service's --profiler-port endpoint (needs "
+                         "the jax collect client; gated with a one-line "
+                         "error when the environment lacks it)")
+    pp.add_argument("--model-dir", default=None,
+                    help="checkpoint to drive in local mode")
+    pp.add_argument("--buckets", nargs="*", default=None, metavar="NxExS")
+    pp.add_argument("--smoke", action="store_true",
+                    help="one tiny bucket (fast local capture)")
+    pp.add_argument("--no-probe", action="store_true")
+    pp.set_defaults(fn=cmd_profile)
 
     p = sub.add_parser("trace", help="per-stage latency table from a "
                                      "--trace-out Chrome-trace file")

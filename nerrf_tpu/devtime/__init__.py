@@ -1,0 +1,58 @@
+"""Device-efficiency plane (docs/device-efficiency.md).
+
+Continuous device-time truth for every compiled program: a unified
+analytic cost model (`costmodel.program_costs` — FLOPs/bytes/HBM floor
+per serve bucket program and the flat train step, XLA cost_analysis
+recorded only as cross-check), live MFU / utilization / useful-FLOPs
+accounting on the scorer's device boundary (`accounting`), a capacity
+headroom predictor over the observed arrival mix (`headroom`), and a
+fail-open jax.profiler capture plane (`capture`) the flight recorder and
+`nerrf profile` drive.  Chip-relative numbers are null-not-fake: no
+published peak, no MFU.
+
+Exports resolve lazily (PEP 562): `peaks`, `headroom` and `capture` are
+jax-free, and eager package imports would drag `costmodel` → jax into
+every consumer — the offline `nerrf doctor` imports
+`devtime.capture.trace_summary` and must stay importable on a host
+where touching jax is unwanted.
+"""
+
+_EXPORTS = {
+    "CHIP_TABLE": "peaks",
+    "ChipPeaks": "peaks",
+    "chip_peak_tflops": "peaks",
+    "chip_peaks": "peaks",
+    "resolve_kind": "peaks",
+    "HeadroomEstimate": "headroom",
+    "HeadroomTracker": "headroom",
+    "predict_headroom": "headroom",
+    "capture_trace": "capture",
+    "profiled": "capture",
+    "trace_summary": "capture",
+    "ProgramCost": "costmodel",
+    "program_cost": "costmodel",
+    "program_costs": "costmodel",
+    "serve_program_costs": "costmodel",
+    "train_step_cost": "costmodel",
+    "xla_cost": "costmodel",
+    "DeviceTimeAccountant": "accounting",
+    "default_peaks": "accounting",
+    "train_efficiency_gauges": "accounting",
+}
+
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name: str):
+    module = _EXPORTS.get(name)
+    if module is None:
+        raise AttributeError(
+            f"module 'nerrf_tpu.devtime' has no attribute {name!r}")
+    import importlib
+
+    return getattr(
+        importlib.import_module(f"nerrf_tpu.devtime.{module}"), name)
+
+
+def __dir__():
+    return __all__

@@ -1,0 +1,111 @@
+"""Profiler capture plane: jax.profiler traces on demand and on breach.
+
+`observability.trace_profile` has existed since the observability module
+landed and was never called from anywhere (a docstring in `tracing.py`
+was its only reference).  This module wires it in, with the production
+contracts the raw context manager lacks:
+
+  * fail-open — a profiler that cannot start (another trace active, an
+    unwritable dir, a backend without profiling) journals a
+    ``profile_failed`` record and the caller proceeds; evidence capture
+    must never take the serving plane down;
+  * every successful capture journals a ``profile_capture`` record with
+    the trace dir and file count, so bundles and `nerrf doctor` can
+    find it;
+  * `capture_trace` is the timed form (capture whatever the process's
+    device threads do for N seconds) used by the flight recorder's
+    opt-in p99-breach action and the `nerrf profile capture` CLI.
+
+The traces are standard jax.profiler output (``plugins/profile/<ts>/``
+with ``*.trace.json.gz`` + ``*.xplane.pb``) — loadable in Perfetto /
+TensorBoard; `trace_summary` gives offline readers (`nerrf doctor`) the
+inventory without parsing them.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import time
+from typing import Optional
+
+
+def _count_trace_files(log_dir: str) -> tuple:
+    files = 0
+    size = 0
+    for root, _dirs, names in os.walk(log_dir):
+        for name in names:
+            files += 1
+            try:
+                size += os.path.getsize(os.path.join(root, name))
+            except OSError:
+                pass
+    return files, size
+
+
+@contextlib.contextmanager
+def profiled(log_dir, enabled: bool = True, journal=None):
+    """Fail-open profiling region around `observability.trace_profile`.
+
+    Yields the trace dir (str) while capturing, or None when disabled or
+    the profiler could not start — callers never branch on profiler
+    health.  Start/stop failures journal ``profile_failed``; a completed
+    capture journals ``profile_capture`` with the file inventory."""
+    if not enabled:
+        yield None
+        return
+    if journal is None:
+        from nerrf_tpu.flight.journal import DEFAULT_JOURNAL
+
+        journal = DEFAULT_JOURNAL
+    from nerrf_tpu.observability import trace_profile
+
+    log_dir = os.fspath(log_dir)
+    cm = trace_profile(log_dir)
+    try:
+        cm.__enter__()
+    except Exception as e:  # noqa: BLE001 — fail-open: no trace, no crash
+        journal.record("profile_failed", dir=log_dir, phase="start",
+                       error=f"{type(e).__name__}: {e}")
+        yield None
+        return
+    try:
+        yield log_dir
+    finally:
+        try:
+            cm.__exit__(None, None, None)
+        except Exception as e:  # noqa: BLE001 — stop failure is fail-open too
+            journal.record("profile_failed", dir=log_dir, phase="stop",
+                           error=f"{type(e).__name__}: {e}")
+        else:
+            files, size = _count_trace_files(log_dir)
+            journal.record("profile_capture", dir=log_dir, files=files,
+                           bytes=size)
+
+
+def capture_trace(log_dir, seconds: float = 1.0, enabled: bool = True,
+                  journal=None) -> Optional[str]:
+    """Capture ``seconds`` of whatever this process's device threads are
+    doing (the scorer keeps scoring while the profiler watches) into
+    ``log_dir``.  Returns the dir on success, None when disabled or the
+    capture failed (fail-open, journaled)."""
+    with profiled(log_dir, enabled=enabled, journal=journal) as active:
+        if active is None:
+            return None
+        deadline = time.monotonic() + max(float(seconds), 0.0)
+        while time.monotonic() < deadline:
+            time.sleep(min(0.05, max(deadline - time.monotonic(), 0.0)))
+    files, _ = _count_trace_files(log_dir)
+    return log_dir if files else None
+
+
+def trace_summary(log_dir) -> Optional[dict]:
+    """Offline inventory of a capture dir (the `nerrf doctor` surface):
+    {"files": N, "bytes": B} or None when the dir is absent/empty."""
+    log_dir = os.fspath(log_dir)
+    if not os.path.isdir(log_dir):
+        return None
+    files, size = _count_trace_files(log_dir)
+    if not files:
+        return None
+    return {"files": files, "bytes": size}

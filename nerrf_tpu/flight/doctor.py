@@ -66,6 +66,14 @@ def read_bundle(path) -> dict:
             out["metrics"] = f.read()
     else:
         out["missing"].append("metrics.prom")
+    # optional embedded jax.profiler capture (the flight recorder's
+    # opt-in profile_on_p99_sec action): inventory only — the trace
+    # itself loads in Perfetto/TensorBoard, not here.  trace_summary is
+    # jax-free and devtime's package init is lazy, so the offline doctor
+    # shares the ONE inventory implementation without touching jax
+    from nerrf_tpu.devtime.capture import trace_summary
+
+    out["profile"] = trace_summary(os.path.join(root, "jax_trace"))
     return out
 
 
@@ -162,6 +170,20 @@ def format_report(bundle: dict, tail: Optional[int] = None) -> str:
                 f"{str(c['fingerprint'] or '-'):<34} "
                 f"{c['reason'] or '-'}".rstrip())
 
+    prof = bundle.get("profile")
+    if prof:
+        man_prof = man.get("profile") or {}
+        lines.append("")
+        lines.append(
+            f"profiler trace: {prof['files']} file(s), {prof['bytes']} "
+            f"bytes in jax_trace/"
+            + (f" ({man_prof['seconds']:g}s capture on the breach)"
+               if man_prof.get("seconds") else "")
+            + " — load in Perfetto or TensorBoard")
+    elif (man.get("profile") or {}).get("error"):
+        lines.append("")
+        lines.append(f"profiler trace: {man['profile']['error']}")
+
     lines.append("")
     if bundle["events"]:
         from nerrf_tpu.tracing import format_stage_table
@@ -217,6 +239,7 @@ def doctor_main(path, tail: Optional[int] = None, as_json: bool = False,
             "records": [r.to_dict() for r in bundle["records"]],
             "compile_provenance": compile_provenance(bundle["records"]),
             "span_events": len(bundle["events"]),
+            "profile": bundle.get("profile"),
             "missing": bundle["missing"],
         }, indent=2))
     else:

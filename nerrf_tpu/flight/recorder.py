@@ -84,6 +84,17 @@ class FlightConfig:
     # rate is single-batch noise, not an incident
     disagreement_spike: float = 0.35
     disagreement_min_windows: int = 8
+    # OPT-IN p99-breach profiler capture (nerrf_tpu/devtime/capture.py):
+    # when > 0, a p99_breach bundle additionally embeds this many seconds
+    # of live jax.profiler trace under <bundle>/jax_trace/ — the scorer
+    # keeps scoring while the profiler watches, so the trace shows the
+    # device during exactly the overload that fired the trigger.
+    # Fail-open: a profiler that cannot start journals profile_failed and
+    # the bundle ships without the trace.  The capture runs on the
+    # dumping thread (the scorer's demux path), so keep it SMALL (≤2 s):
+    # it stalls demux for its duration, once per rate-limit interval.
+    # 0 (default) disables
+    profile_on_p99_sec: float = 0.0
 
 
 class FlightRecorder:
@@ -264,6 +275,28 @@ class FlightRecorder:
             shutil.rmtree(tmp)
         try:
             os.makedirs(tmp)
+            profile = None
+            if trigger == "p99_breach" and self.cfg.profile_on_p99_sec > 0:
+                # capture FIRST (the overload is happening now; the
+                # journal tail written below then includes the capture's
+                # own profile_capture/profile_failed record), into the
+                # tmp dir so the os.replace below keeps bundles atomic
+                from nerrf_tpu.devtime.capture import (
+                    capture_trace,
+                    trace_summary,
+                )
+
+                pdir = os.path.join(tmp, "jax_trace")
+                got = capture_trace(pdir,
+                                    seconds=self.cfg.profile_on_p99_sec,
+                                    journal=self._journal)
+                summary = trace_summary(pdir) if got else None
+                profile = ({"dir": "jax_trace",
+                            "seconds": self.cfg.profile_on_p99_sec,
+                            **summary} if summary else
+                           {"dir": None,
+                            "error": "profiler capture failed (fail-open; "
+                                     "see profile_failed journal record)"})
             records = self._journal.tail(self.cfg.journal_tail)
             with open(os.path.join(tmp, "journal.jsonl"), "w") as f:
                 for r in records:
@@ -285,6 +318,7 @@ class FlightRecorder:
                                 "records": len(records)},
                 "slo": self._slo.snapshot() if self._slo is not None
                        else None,
+                "profile": profile,
                 "lineage": _safe(self._info),
                 "env": env_fingerprint(),
             }

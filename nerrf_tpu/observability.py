@@ -13,7 +13,11 @@ the real thing for our host plane:
   * `trace_profile` — context manager around the JAX profiler so any train
     or inference loop can emit an XLA trace for TensorBoard/Perfetto (the
     TPU analogue of the reference's promised bpftool introspection,
-    `implementation.mdx:569-589`).
+    `implementation.mdx:569-589`).  Production callers go through the
+    fail-open wrapper in `nerrf_tpu/devtime/capture.py` (journaled
+    capture/failure records, `nerrf profile capture`, the flight
+    recorder's profile-on-p99-breach action); this stays the raw
+    primitive.
 
 Device-side step metrics (loss, ROC-AUC, steps/s) stay in
 `nerrf_tpu.train.metrics`; this module is where they get *exported*.

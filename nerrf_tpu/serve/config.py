@@ -94,6 +94,12 @@ class ServeConfig:
     # and leave() stops waiting, instead of every stream hanging on a
     # dead scorer thread; 0 disables
     scorer_wedge_sec: float = 60.0
+    # device-efficiency plane (nerrf_tpu/devtime): live per-program MFU /
+    # utilization / useful-FLOPs gauges and the capacity-headroom
+    # predictor, fed from the scorer's measured device seconds.  Host-side
+    # numpy only (no extra device work, no recompiles); False drops the
+    # plane entirely for minimal embedders
+    devtime_accounting: bool = True
 
     @property
     def occupancy(self) -> int:

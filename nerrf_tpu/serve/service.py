@@ -36,6 +36,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from nerrf_tpu.data.loaders import Trace
+from nerrf_tpu.devtime import DeviceTimeAccountant, program_cost
 from nerrf_tpu.flight.journal import DEFAULT_JOURNAL, fingerprint, make_trace_id
 from nerrf_tpu.flight.slo import SLOTracker
 from nerrf_tpu.graph.builder import NODE_TYPE_FILE, measure_window
@@ -174,6 +175,20 @@ class OnlineDetectionService:
         # registry histogram gives means, this gives exact percentiles and
         # per-window version stamps (bench/SLO + swap-bench reporting)
         self._window_log = window_log
+        # device-efficiency plane (nerrf_tpu/devtime): per-program MFU /
+        # utilization / useful-FLOPs gauges from the scorer's measured
+        # device seconds + the analytic cost model registered at warmup,
+        # and the capacity-headroom predictor over the admit stream.
+        # Chip-relative gauges stay absent on CPU (null-not-fake)
+        self._devtime = (DeviceTimeAccountant(registry=registry,
+                                              journal=self._journal)
+                         if self.cfg.devtime_accounting else None)
+        # the background cost-registration thread (start()) + its stop
+        # flag: stop() must be able to wait it out — a daemon thread
+        # still inside jax tracing when the interpreter tears down is a
+        # SIGSEGV (caught by test_compilecache's fast cache-warm exit)
+        self._devtime_thread: Optional[threading.Thread] = None
+        self._devtime_stop = threading.Event()
 
     # -- device program -------------------------------------------------------
 
@@ -193,11 +208,36 @@ class OnlineDetectionService:
             params = self._params
             version = self._live_version
             shadow = self._shadow
+        t_dev = time.perf_counter()
         out = jax.device_get(self._run_eval(params, batch))
+        device_sec = time.perf_counter() - t_dev
         probs = 1.0 / (1.0 + np.exp(-out["node_logit"]))
+        if self._devtime is not None and self._warm:
+            # steady state only: the warmup donor call's seconds include
+            # the compile/deserialize, which would poison the trailing
+            # MFU/util window at boot
+            self._observe_devtime(batch, device_sec)
         if shadow is not None:
             self._shadow_score(shadow, batch, probs)
         return probs, version
+
+    def _observe_devtime(self, batch: Dict[str, np.ndarray],
+                         device_sec: float) -> None:
+        """Feed one scoring call to the efficiency accountant: the bucket
+        tag comes from the padded shapes (exactly how the program is
+        keyed), occupancy from which slots carry real nodes, and the
+        padding discount from the occupied slots' node-mask density."""
+        n = batch["node_feat"].shape[1]
+        e = batch["edge_src"].shape[1]
+        s = batch["seq_feat"].shape[1]
+        tag = f"{n}n/{e}e/{s}s"
+        mask = np.asarray(batch["node_mask"])
+        occupied = mask.any(axis=1)
+        occ = int(occupied.sum())
+        density = float(mask[occupied].mean()) if occ else None
+        self._devtime.observe_batch(
+            f"serve_eval[{tag}]", tag, device_sec,
+            occupancy=occ, slots=int(mask.shape[0]), real_density=density)
 
     def _run_eval(self, params, batch):
         """One eval launch: the bucket's staged AOT executable when there
@@ -282,6 +322,13 @@ class OnlineDetectionService:
     @property
     def slo(self) -> SLOTracker:
         return self._slo
+
+    @property
+    def devtime(self) -> Optional[DeviceTimeAccountant]:
+        """The device-efficiency accountant (None when disabled) — the
+        serve bench reads its snapshot() into the artifact's devtime
+        block."""
+        return self._devtime
 
     def flight_info(self) -> dict:
         """Live identity for a flight bundle's manifest: which model is
@@ -368,6 +415,24 @@ class OnlineDetectionService:
                     f"({self.warmup_seconds[tag]}s, "
                     f"{self.warmup_source[tag]})")
 
+    def _register_devtime_costs(self) -> None:
+        """Resolve the analytic cost of every warmup bucket program and
+        bind it to the accountant (background; see start()).  Best-effort
+        throughout: a failed trace leaves that program's MFU gauge
+        absent, never blocks or raises into the serving plane."""
+        try:
+            for _bucket, tag, batch in warmup_batches(self.cfg):
+                if self._devtime_stop.is_set():
+                    return  # stopping: remaining costs don't matter
+                cost = program_cost(
+                    self._eval_fn, self._params, batch,
+                    program=f"serve_eval[{tag}]",
+                    batch_slots=self.cfg.batch_size)
+                if cost is not None:
+                    self._devtime.register_cost(f"serve_eval[{tag}]", cost)
+        except Exception:  # noqa: BLE001 — advisory gauges only
+            pass
+
     def _stage_program(self, tag: str, batch: Dict[str, np.ndarray]) -> str:
         """Resolve one bucket's eval program through the compile cache and
         stage it for the scorer thread.  Returns the provenance ("cache" /
@@ -413,6 +478,19 @@ class OnlineDetectionService:
         if self.cfg.warmup_on_start:
             self._warmup(log=log)
         self._warm = True
+        if self._devtime is not None:
+            # cost-model registration OFF the boot path: analytic FLOPs
+            # per bucket program (shape-level make_jaxpr, no compile, no
+            # device work — zero-recompile contract untouched) resolve on
+            # a daemon thread so readiness never waits on them.  Until a
+            # program's cost lands its MFU gauge is simply absent — the
+            # seconds/util gauges flow from the first scored batch either
+            # way
+            self._devtime_stop.clear()
+            self._devtime_thread = threading.Thread(
+                target=self._register_devtime_costs, daemon=True,
+                name="nerrf-devtime-costs")
+            self._devtime_thread.start()
         self._batcher.start()
         self._admission_open = True
         self._journal.record("readiness", ready=True,
@@ -449,6 +527,15 @@ class OnlineDetectionService:
             self._journal.record("readiness", ready=False, reason="stopping")
         self._admission_open = False
         self._batcher.stop(drain=drain)
+        if self._devtime_thread is not None:
+            # wait the cost thread out (bounded): a daemon thread still
+            # inside jax tracing when the interpreter tears down after a
+            # fast boot-and-exit (cache warm CLI) segfaults the process.
+            # The stop flag skips remaining buckets; the in-progress
+            # trace is O(seconds)
+            self._devtime_stop.set()
+            self._devtime_thread.join(timeout=30.0)
+            self._devtime_thread = None
 
     # -- stream membership ----------------------------------------------------
 
@@ -766,6 +853,10 @@ class OnlineDetectionService:
             self._reg.counter_inc(
                 "serve_windows_admitted_total",
                 help="windows admitted into the micro-batcher")
+            if self._devtime is not None:
+                # capacity headroom: the arrival side of the model (BASE
+                # stream name — reconnect sessions are the same demand)
+                self._devtime.observe_admit(base, bucket_tag(bucket))
             self._batcher.submit(req)
 
     # -- demux ----------------------------------------------------------------

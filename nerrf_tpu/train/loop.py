@@ -679,6 +679,19 @@ def train_nerrfnet(
             "train_data_wait_fraction", data_wait_s / elapsed,
             help="fraction of steady-state train wall spent assembling or "
                  "waiting for input batches")
+    # device-efficiency plane: analytic step FLOPs x measured steps/s →
+    # nerrf_device_mfu{program="train_step"} + roofline intensity.
+    # Shape-level trace only (no compile), best-effort by contract, and
+    # the MFU gauge stays absent off-chip (null-not-fake).  Spanned: the
+    # cost trace takes ~a second and the trace-coverage acceptance
+    # (test_tracing) rightly refuses unattributed wall time
+    with tracer.span("devtime_cost", program="train_step"):
+        from nerrf_tpu.devtime import train_efficiency_gauges
+
+        eff = train_efficiency_gauges(model, cfg, train_ds.arrays,
+                                      steps_per_sec)
+    if eff and log:
+        log(f"device efficiency: {eff}")
 
     metrics = evaluate(
         eval_fn, state.params, eval_ds if eval_ds is not None else train_ds,

@@ -77,6 +77,26 @@ timeout 560 env JAX_PLATFORMS=cpu python benchmarks/run_chaos_bench.py \
     --smoke > "$WORK/chaos_smoke.json"
 echo "e2e: chaos smoke survival gates pass"
 
+# pre-flight: devtime smoke — the device-efficiency cost table (analytic
+# FLOPs / byte floor / roofline intensity for the serve ladder + flat
+# train step) resolves on CPU with every chip-relative column null
+# (docs/device-efficiency.md).  The same command run on a chip prints
+# the measured MFU table with zero extra work.
+timeout 300 env JAX_PLATFORMS=cpu python -m nerrf_tpu.cli profile costs \
+    --smoke --no-probe --json > "$WORK/devtime_smoke.json"
+python - "$WORK/devtime_smoke.json" <<'EOF'
+import json, sys
+r = json.load(open(sys.argv[1]))
+assert r["peaks"] is None, "CPU rig must not report chip peaks"
+assert r["programs"], "cost table empty"
+for name, p in r["programs"].items():
+    assert p["flops"] > 0 and p["bytes_accessed"] > 0, name
+    assert (p.get("measured") or {}).get("mfu") is None, \
+        f"{name}: fabricated MFU on CPU"
+print(f"e2e: devtime cost table resolves ({len(r['programs'])} programs, "
+      "chip-relative columns null on CPU)")
+EOF
+
 if [ "$MODE" = "live" ]; then
     make -C native build/nerrf-trackerd >/dev/null
     rc=0

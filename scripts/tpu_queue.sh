@@ -52,6 +52,17 @@ then
   exit 1
 fi
 log "pre-flight: chaos smoke survival gates pass"
+# pre-flight: devtime cost table on CPU — the analytic cost model must
+# resolve for the whole serve ladder + train step with every
+# chip-relative column null (docs/device-efficiency.md); fails in
+# seconds, before any tunnel time
+if ! timeout 300 env JAX_PLATFORMS=cpu python -m nerrf_tpu.cli profile costs \
+  --smoke --no-probe --json > /tmp/devtime_smoke.json 2>> /tmp/tpu_queue.log
+then
+  log "PRE-FLIGHT FAIL: devtime cost table (/tmp/devtime_smoke.json)"
+  exit 1
+fi
+log "pre-flight: devtime cost table resolves (chip-relative columns null on CPU)"
 # the gate must exercise the full enumerate->compile->execute path: the
 # relay has been seen half-up (enumeration answering, remote_compile
 # refusing), which passes an enumeration-only check and then wedges the
@@ -96,6 +107,16 @@ then
   exit 1
 fi
 log "pre-flight: compile cache round-trips (second sweep source=cache)"
+# first chip-side MFU table (docs/device-efficiency.md): the same cost
+# table the CPU pre-flight proved, now with measured seconds/call and a
+# non-null MFU column — the round's first device-efficiency numbers,
+# before any long training burns the tunnel window.  Advisory: a failure
+# logs and the queue continues (the table is evidence, not a gate).
+log "chip-side devtime MFU table (serve ladder, measured)"
+timeout 1800 python -m nerrf_tpu.cli profile costs --measure 4 --no-probe \
+  > /tmp/devtime_mfu.txt 2>> /tmp/tpu_queue.log \
+  && log "devtime MFU table written (/tmp/devtime_mfu.txt)" \
+  || log "devtime MFU table FAILED (advisory; /tmp/tpu_queue.log)"
 # require the regenerated zero-drop corpus with the stealth variants:
 # training the flagship on an older corpus would leave it blind to exactly
 # the scenarios the adversarial eval measures (VERDICT r3 item 3)

@@ -39,6 +39,15 @@ then
   exit 1
 fi
 log "pre-flight: chaos smoke survival gates pass"
+# same devtime pre-flight as tpu_queue.sh: the cost table must resolve
+# on CPU with chip-relative columns null (docs/device-efficiency.md)
+if ! timeout 300 env JAX_PLATFORMS=cpu python -m nerrf_tpu.cli profile costs \
+  --smoke --no-probe --json > /tmp/devtime_smoke.json 2>> /tmp/tpu_queue.log
+then
+  log "PRE-FLIGHT FAIL: devtime cost table (/tmp/devtime_smoke.json)"
+  exit 1
+fi
+log "pre-flight: devtime cost table resolves (chip-relative columns null on CPU)"
 tpu_ok() {
   python -c "
 import sys
@@ -70,6 +79,13 @@ then
   exit 1
 fi
 log "pre-flight: compile cache round-trips (second sweep source=cache)"
+# first chip-side MFU table (docs/device-efficiency.md) ahead of the
+# bench: measured seconds/call + non-null MFU per serve bucket.
+# Advisory — the table is evidence, not a gate.
+timeout 1800 python -m nerrf_tpu.cli profile costs --measure 4 --no-probe \
+  > /tmp/devtime_mfu.txt 2>> /tmp/tpu_queue.log \
+  && log "devtime MFU table written (/tmp/devtime_mfu.txt)" \
+  || log "devtime MFU table FAILED (advisory; /tmp/tpu_queue.log)"
 timeout 3600 python bench.py > /tmp/bench_smoke.json 2> /tmp/bench_smoke.log
 log "bench rc=$?"
 log "2/4 chip-gated compiled-kernel test"
