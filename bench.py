@@ -628,6 +628,39 @@ def main() -> None:
         return _smoke_or_artifact("chaos", "run_chaos_bench.py",
                                   "chaos_bench_cpu.json", surface)
 
+    def _quality():
+        # detection-quality plane: the drift-injection legs' verdicts —
+        # shifted traffic fires exactly one drift bundle, unshifted stays
+        # below threshold with parity intact (docs/quality.md)
+        def surface(r):
+            return {
+                "streams": r.get("streams"),
+                "psi_breach": r.get("psi_breach"),
+                "reference_windows": (r.get("reference") or {}).get(
+                    "windows"),
+                "unshifted_worst_score_psi": (r.get("unshifted") or {}).get(
+                    "worst_score_psi"),
+                "unshifted_bundles": (r.get("unshifted") or {}).get(
+                    "bundles"),
+                "unshifted_parity_bit_identical": (
+                    r.get("unshifted") or {}).get(
+                    "parity_bit_identical_to_model_detect"),
+                "shifted_worst_score_psi": (r.get("shifted") or {}).get(
+                    "worst_score_psi"),
+                "shifted_worst_feature_psi": (r.get("shifted") or {}).get(
+                    "worst_feature_psi"),
+                "shifted_bundles": (r.get("shifted") or {}).get("bundles"),
+                "shifted_bundle_doctor_ok": (r.get("shifted") or {}).get(
+                    "bundle_doctor_ok"),
+                "recompiles_after_warmup": r.get("recompiles_after_warmup"),
+                "backend": r.get("backend"),
+                "smoke": r.get("smoke"),
+                "provenance": r.get("provenance"),
+            }
+
+        return _smoke_or_artifact("quality", "run_quality_bench.py",
+                                  "quality_bench_cpu.json", surface)
+
     def _swap():
         # model-lifecycle hot-swap: 2 streams, one mid-run swap + rollback
         def surface(r):
@@ -659,7 +692,7 @@ def main() -> None:
     for key, loader in (("corpus100h", _j100), ("adversarial", _adv),
                         ("m1_recovery", _recovery), ("tracker", _tracker),
                         ("serve", _serve), ("model_swap", _swap),
-                        ("chaos", _chaos)):
+                        ("chaos", _chaos), ("quality", _quality)):
         try:
             entry = loader()
             if entry is not None:

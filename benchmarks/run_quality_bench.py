@@ -1,0 +1,318 @@
+#!/usr/bin/env python3
+"""Drift-injection bench: the detection-quality plane end to end.
+
+Proves the quality plane's one-sentence contract on the REAL serve path:
+a model serving the traffic it was calibrated on stays quiet; the same
+model serving shifted traffic fires exactly one drift bundle.
+
+Flow (one service, one warmup — zero recompiles across both legs):
+
+  1. build a reference quality profile over a held-out corpus scored
+     through the real eval path (what `calibrate_and_resave` stamps into
+     a published checkpoint);
+  2. **unshifted leg** — N wire streams drawn from the same generator
+     family (fresh seeds) through the full serve path with the monitor
+     armed: every PSI must stay below the breach threshold, zero
+     ``quality_drift`` bundles, and stream 0's DetectionResult must stay
+     bit-identical to offline `model_detect` (the drift plane rides the
+     demux boundary — it must never perturb scoring);
+  3. **shifted leg** — the same load with `SimConfig.drift` injected
+     (denser, IO-heavy benign mix): the sustained-PSI trigger must fire
+     EXACTLY once (rate-limited), the bundle must embed both sketch sets
+     (live + reference, ``quality.json``) and be `nerrf doctor`-readable
+     offline.
+
+    python benchmarks/run_quality_bench.py           # 4 streams/leg
+    python benchmarks/run_quality_bench.py --smoke   # 2 streams/leg
+    python benchmarks/run_quality_bench.py --out results/quality_bench_cpu.json
+
+Prints ONE JSON line (the artifact); exit 1 if any gate fails.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+BUCKET = (256, 512, 128)
+DRIFT = 0.8
+
+
+def _worst_psis(snapshot: dict) -> tuple:
+    """(worst stream score PSI, worst feature PSI) from a monitor
+    snapshot — None when no stream/feature cleared its evidence gate."""
+    score = [s["score_psi"] for s in (snapshot.get("per_stream") or
+                                      {}).values()
+             if s.get("score_psi") is not None]
+    feat = [f["psi"] for f in (snapshot.get("features") or {}).values()
+            if f.get("psi") is not None]
+    return (max(score) if score else None, max(feat) if feat else None)
+
+
+def run(streams: int = 4, sim_seconds: float = 180.0,
+        smoke: bool = False,
+        log=lambda *a: print(*a, file=sys.stderr, flush=True)) -> dict:
+    """Importable harness body (the slow-marked tier-1 smoke calls this
+    in-process).  Returns the artifact dict."""
+    if smoke:
+        streams = 2
+    log = log or (lambda *a: None)
+    import jax
+
+    from nerrf_tpu.data.loaders import Trace
+    from nerrf_tpu.data.synth import SimConfig, simulate_trace
+    from nerrf_tpu.flight import FlightConfig, FlightRecorder
+    from nerrf_tpu.flight.doctor import format_report, read_bundle
+    from nerrf_tpu.flight.journal import EventJournal
+    from nerrf_tpu.ingest.service import TraceReplayServer, TrackerClient
+    from nerrf_tpu.models import JointConfig, NerrfNet
+    from nerrf_tpu.observability import MetricsRegistry
+    from nerrf_tpu.pipeline import model_detect
+    from nerrf_tpu.quality import (
+        QualityConfig,
+        QualityMonitor,
+        build_reference_profile,
+    )
+    from nerrf_tpu.serve import (
+        OnlineDetectionService,
+        ServeConfig,
+        bucket_tag,
+        init_untrained_params,
+    )
+
+    backend = jax.default_backend()
+    cfg = ServeConfig(
+        buckets=(BUCKET,), batch_size=8, batch_close_sec=0.1,
+        window_sec=15.0, stride_sec=5.0,
+        stream_queue_slots=512, alert_queue_slots=4096,
+        window_deadline_sec=2.0)
+    model = NerrfNet(JointConfig().small)
+    params = init_untrained_params(model, cfg)
+    registry = MetricsRegistry(namespace="qbench")
+    journal = EventJournal(capacity=8192, registry=registry)
+    # bench-scale evidence gates: the legs see ~20 windows per stream, so
+    # the monitor must judge on that much evidence (production defaults
+    # wait for 32 windows / 256 scores per stream)
+    monitor = QualityMonitor(
+        QualityConfig(min_windows=10, min_scores=150, journal_every=4,
+                      trailing_windows=1024,
+                      feature_trailing_windows=1024),
+        registry=registry, journal=journal)
+    svc = OnlineDetectionService(params, model, cfg=cfg, registry=registry,
+                                 journal=journal, quality_monitor=monitor)
+    t0 = time.perf_counter()
+    svc.start(log=log)
+    log(f"[quality-bench] service warm in {time.perf_counter() - t0:.1f}s")
+
+    # the reference profile: the distribution this (model, threshold)
+    # pair expects — held-out seeds, same generator family as the
+    # unshifted leg, scored through the real eval path
+    def sim(seed: int, drift: float, attack: bool) -> "SimConfig":
+        return SimConfig(duration_sec=sim_seconds, attack=attack,
+                         attack_start_sec=sim_seconds / 3,
+                         num_target_files=4, benign_rate_hz=6.0,
+                         seed=seed, drift=drift)
+
+    ref_traces = [simulate_trace(sim(500 + i, 0.0, attack=(i % 2 == 0)))
+                  for i in range(max(streams, 4))]
+    profile = build_reference_profile(
+        params, model, ref_traces, ds_cfg=cfg.dataset_config(BUCKET),
+        threshold=(cfg.threshold if cfg.threshold is not None else 0.5),
+        log=log)
+
+    # the trigger may only judge once the trailing population spans most
+    # of a full traffic cycle per stream: the synthetic traffic is
+    # non-stationary WITHIN a trace (benign prefix → attack burst), so a
+    # young trailing set is a genuinely biased subsample of the reference
+    # and PSI reads high on identical distributions (measured 1.1 at 30
+    # of 60 windows, 0.1 at the full leg).  80% of the expected windows
+    # is past the transient with margin on both sides of the 0.25 cut
+    windows_per_stream = int((sim_seconds - cfg.window_sec)
+                             / cfg.stride_sec) + 1
+    flight_cfg = dict(
+        quality_psi_breach=0.25,
+        quality_min_windows=int(streams * windows_per_stream * 0.8),
+        quality_breach_records=2, min_interval_sec=3600.0,
+        # only the drift trigger is under test: park the others
+        drop_burst_n=10 ** 6, p99_breach_sec=None)
+    work = tempfile.mkdtemp(prefix="nerrf-quality-bench-")
+
+    def leg(name: str, drift: float, seed_base: int,
+            check_parity: bool) -> dict:
+        out_dir = os.path.join(work, name)
+        svc.set_quality_profile(profile.to_dict(), version=1)
+        recorder = FlightRecorder(
+            FlightConfig(out_dir=out_dir, **flight_cfg),
+            registry=registry, journal=journal, slo=svc.slo,
+            info=svc.flight_info, quality=svc.quality_snapshot, log=log)
+        traces, servers, targets = [], [], []
+        for i in range(streams):
+            tr = simulate_trace(sim(seed_base + 97 * i, drift,
+                                    attack=(i % 2 == 0)))
+            srv = TraceReplayServer(tr.events, tr.strings, batch_size=256)
+            port = srv.start()
+            traces.append(tr)
+            servers.append(srv)
+            targets.append(f"127.0.0.1:{port}")
+        t0 = time.perf_counter()
+        runs = [svc.connect(f"{name}{i}", targets[i], timeout=300.0)
+                for i in range(streams)]
+        for r in runs:
+            r.done.wait(timeout=600.0)
+        wall = time.perf_counter() - t0
+        errors = {r.stream: repr(r.error) for r in runs if r.error}
+        parity = None
+        if check_parity:
+            # the drift plane must never perturb scoring: stream 0 vs
+            # offline model_detect on the same decoded bytes, exactly the
+            # serve bench's parity leg
+            ev, strings = TrackerClient(targets[0]).stream(timeout=60.0)
+            offline = model_detect(
+                Trace(events=ev, strings=strings, ground_truth=None,
+                      labels=None, name=f"{name}0"),
+                params, model, ds_cfg=cfg.dataset_config(BUCKET),
+                auto_capacity=False, batch_size=cfg.batch_size)
+            served = runs[0].result
+            parity = (
+                served is not None
+                and served.file_scores == offline.file_scores
+                and served.file_window_scores == offline.file_window_scores
+                and served.proc_scores == offline.proc_scores
+                and served.threshold == offline.threshold)
+        snapshot = svc.quality_snapshot() or {}
+        recorder.close()
+        for srv in servers:
+            srv.stop()
+        worst_score, worst_feat = _worst_psis(snapshot)
+        bundles = sorted(p for p in (os.listdir(out_dir)
+                                     if os.path.isdir(out_dir) else [])
+                         if p.startswith("bundle-"))
+        result = {
+            "drift": drift,
+            "wall_seconds": round(wall, 2),
+            "windows_observed": snapshot.get("windows_observed", 0),
+            "worst_score_psi": worst_score,
+            "worst_feature_psi": worst_feat,
+            "margin_mass": snapshot.get("margin_mass"),
+            "bundles": len(bundles),
+            "bundle_names": bundles,
+            "stream_errors": errors or None,
+        }
+        if check_parity:
+            result["parity_bit_identical_to_model_detect"] = bool(parity)
+        if bundles:
+            # the drift bundle must be self-contained, offline-readable
+            # evidence: doctor renders it, quality.json embeds BOTH
+            # sketch sets (live trailing + the full reference profile)
+            b = read_bundle(os.path.join(out_dir, bundles[0]))
+            report = format_report(b)
+            q = b.get("quality") or {}
+            result["bundle_trigger"] = bundles[0].rsplit("-", 1)[-1]
+            result["bundle_doctor_ok"] = (
+                not b["missing"]
+                and "detection quality (drift" in report
+                and "incident timeline" in report)
+            result["bundle_has_live_sketches"] = any(
+                s.get("score_sketch") for s in
+                (q.get("per_stream") or {}).values())
+            result["bundle_has_reference_profile"] = bool(
+                (q.get("reference") or {}).get("score"))
+        log(f"[quality-bench] leg {name}: {result['windows_observed']} "
+            f"windows, worst score PSI {worst_score}, worst feature PSI "
+            f"{worst_feat}, bundles {len(bundles)}")
+        return result
+
+    try:
+        unshifted = leg("u", 0.0, seed_base=1000, check_parity=True)
+        shifted = leg("d", DRIFT, seed_base=3000, check_parity=False)
+    finally:
+        svc.stop()
+        shutil.rmtree(work, ignore_errors=True)
+
+    tag = bucket_tag(BUCKET)
+    recompiles = int(registry.value("serve_recompiles_total",
+                                    labels={"bucket": tag}))
+    result = {
+        "metric": "quality_drift_detection",
+        "value": shifted.get("worst_score_psi"),
+        "unit": "worst trailing score PSI under injected drift "
+                f"(threshold {flight_cfg['quality_psi_breach']})",
+        "backend": backend,
+        "smoke": smoke or None,
+        "streams": streams,
+        "psi_breach": flight_cfg["quality_psi_breach"],
+        "reference": profile.summary(),
+        "unshifted": unshifted,
+        "shifted": shifted,
+        "recompiles_after_warmup": recompiles,
+        "alerts_emitted": int(sum(
+            registry.value("serve_alerts_emitted_total",
+                           labels={"stream": f"{leg_name}{i}"})
+            for leg_name in ("u", "d") for i in range(streams))),
+        "provenance": "python benchmarks/run_quality_bench.py"
+                      + (" --smoke" if smoke else ""),
+    }
+    return result
+
+
+def gates(result: dict) -> list:
+    """Every acceptance gate, as (name, ok) — shared by main() and the
+    artifact-of-record test."""
+    u, d = result["unshifted"], result["shifted"]
+    breach = result["psi_breach"]
+    below = [v for v in (u.get("worst_score_psi"),
+                         u.get("worst_feature_psi")) if v is not None]
+    return [
+        ("unshifted_no_bundles", u["bundles"] == 0),
+        ("unshifted_psi_below_breach",
+         bool(below) and max(below) < breach),
+        ("unshifted_parity_bit_identical",
+         u.get("parity_bit_identical_to_model_detect") is True),
+        ("unshifted_no_stream_errors", u.get("stream_errors") is None),
+        ("shifted_exactly_one_bundle", d["bundles"] == 1),
+        ("shifted_bundle_is_quality_drift",
+         d.get("bundle_trigger") == "quality_drift"),
+        ("shifted_bundle_doctor_ok", d.get("bundle_doctor_ok") is True),
+        ("shifted_bundle_embeds_both_sketch_sets",
+         d.get("bundle_has_live_sketches") is True
+         and d.get("bundle_has_reference_profile") is True),
+        ("shifted_no_stream_errors", d.get("stream_errors") is None),
+        ("zero_recompiles", result["recompiles_after_warmup"] == 0),
+    ]
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--streams", type=int, default=4)
+    ap.add_argument("--seconds", type=float, default=180.0,
+                    help="simulated seconds of trace per stream")
+    ap.add_argument("--smoke", action="store_true",
+                    help="2 streams per leg, short traces")
+    ap.add_argument("--out", default=None, metavar="FILE",
+                    help="also write the artifact JSON here")
+    args = ap.parse_args(argv)
+
+    result = run(streams=args.streams, sim_seconds=args.seconds,
+                 smoke=args.smoke)
+    print(json.dumps(result))
+    if args.out:
+        os.makedirs(os.path.dirname(os.path.abspath(args.out)),
+                    exist_ok=True)
+        with open(args.out, "w") as f:
+            f.write(json.dumps(result, indent=2) + "\n")
+    failed = [name for name, ok in gates(result) if not ok]
+    for name in failed:
+        print(f"[quality-bench] GATE FAILED: {name}", file=sys.stderr)
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

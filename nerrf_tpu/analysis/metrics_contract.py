@@ -80,6 +80,15 @@ REQUIRED = (
     "device_useful_flops_fraction",
     "device_roofline_intensity",
     "capacity_headroom_streams",
+    # the detection-quality plane (docs/quality.md; the drift-response
+    # runbook and the quality bench's gates key off these exact names —
+    # all ABSENT until the live version carries a reference profile,
+    # null-not-fake, but their call sites must stay registered)
+    "quality_score_psi",
+    "quality_feature_psi",
+    "quality_alert_rate_z",
+    "quality_calibration_margin_mass",
+    "serve_alerts_emitted_total",
 )
 
 _CALL = re.compile(

@@ -421,6 +421,105 @@ def cmd_cache(args) -> int:
 
 
 # --------------------------------------------------------------------------
+def cmd_quality(args) -> int:
+    """The detection-quality plane's offline face (docs/quality.md):
+    ``show`` renders a reference profile (checkpoint sidecar or bare
+    JSON) or a flight bundle's live divergence table; ``compare`` PSIs
+    two profiles against each other — score distribution, top-drifting
+    window features, margin mass and alert-rate deltas."""
+    from nerrf_tpu.quality import load_profile
+    from nerrf_tpu.quality.sketch import psi, top_drifting
+
+    def _load(path):
+        """→ ("bundle", quality dict) | ("profile", QualityProfile)."""
+        p = Path(path)
+        if p.is_dir() and (p / "quality.json").is_file():
+            return "bundle", json.loads((p / "quality.json").read_text())
+        prof = load_profile(p)
+        if prof is None:
+            raise FileNotFoundError(
+                f"{path} is neither a quality profile (no "
+                f"quality_profile.json), nor a flight bundle with a "
+                f"quality.json — the checkpoint may predate profiles")
+        return "profile", prof
+
+    if args.quality_cmd == "show":
+        try:
+            kind, obj = _load(args.path)
+        except (FileNotFoundError, ValueError) as e:
+            _log(str(e))
+            return 2
+        if kind == "bundle":
+            if args.json:
+                print(json.dumps(obj, indent=2))
+                return 0
+            from nerrf_tpu.flight.doctor import quality_section
+
+            print("\n".join(quality_section(obj)))
+            return 0
+        if args.json:
+            print(json.dumps(obj.to_dict(), indent=2))
+            return 0
+        s = obj.summary()
+        print(f"quality profile (schema v{s['schema']}): "
+              f"{s['windows']} windows / {s['node_scores']} node scores")
+        print(f"  threshold {s['threshold']:g}  margin mass "
+              f"{s['margin_mass']:g} (eps {s['margin_eps']:g})  "
+              f"alert rate {s['alert_rate']:g}")
+        q = s["score_quantiles"]
+        print(f"  score quantiles p50/p90/p99: "
+              f"{q['p50']}/{q['p90']}/{q['p99']}")
+        for name in s["features"]:
+            fq = obj.features[name].quantiles()
+            print(f"  feature {name:<16} p50/p90/p99: "
+                  f"{fq['p50']}/{fq['p90']}/{fq['p99']} "
+                  f"({obj.features[name].total} samples)")
+        return 0
+
+    if args.quality_cmd == "compare":
+        try:
+            _, ref = _load(args.reference)
+            _, other = _load(args.other)
+        except (FileNotFoundError, ValueError) as e:
+            _log(str(e))
+            return 2
+        if not hasattr(ref, "score") or not hasattr(other, "score"):
+            _log("compare wants two PROFILES (use `show` for a bundle's "
+                 "live table)")
+            return 2
+        score_psi = psi(ref.score, other.score)
+        feats = top_drifting(ref.features, other.features)
+        out = {
+            "score_psi": round(score_psi, 4),
+            "feature_psi": {k: round(v, 4) for k, v in feats},
+            "margin_mass": {"reference": round(ref.margin_mass, 4),
+                            "other": round(other.margin_mass, 4)},
+            "alert_rate": {"reference": round(ref.alert_rate, 4),
+                           "other": round(other.alert_rate, 4)},
+            "windows": {"reference": ref.windows, "other": other.windows},
+        }
+        if args.json:
+            print(json.dumps(out, indent=2))
+        else:
+            print(f"score PSI {score_psi:.4f} "
+                  f"(<0.1 stable, 0.1-0.25 moderate, >0.25 major)")
+            print("top drifting features:")
+            for k, v in feats:
+                print(f"  {k:<16} PSI {v:.4f}")
+            print(f"margin mass {ref.margin_mass:.4f} -> "
+                  f"{other.margin_mass:.4f}   alert rate "
+                  f"{ref.alert_rate:.4f} -> {other.alert_rate:.4f}")
+        if args.psi_threshold is not None:
+            worst = max([score_psi] + [v for _, v in feats])
+            if worst >= args.psi_threshold:
+                _log(f"PSI {worst:.4f} >= {args.psi_threshold:g}")
+                return 1
+        return 0
+    _log(f"unknown quality subcommand {args.quality_cmd!r}")
+    return 2  # pragma: no cover — argparse enforces the choices
+
+
+# --------------------------------------------------------------------------
 def cmd_warmup(args) -> int:
     """Host-provisioning compile sweep: detector eval programs for every
     configured capacity bucket + the device planner, into the persistent
@@ -909,6 +1008,7 @@ def cmd_serve_detect(args) -> int:
 
     manager = None
     executables_dir = None
+    quality_profile = None
     if args.registry:
         # registry mode: boot from the lineage's LIVE version and keep a
         # ModelManager polling — retrained checkpoints published into the
@@ -937,6 +1037,7 @@ def cmd_serve_detect(args) -> int:
              + (" (AOT executables sidecar found)" if executables_dir
                 else ""))
     elif args.model_dir:
+        from nerrf_tpu.quality import load_profile
         from nerrf_tpu.train.checkpoint import load_calibration, load_checkpoint
 
         params, model_cfg = load_checkpoint(args.model_dir)
@@ -944,6 +1045,16 @@ def cmd_serve_detect(args) -> int:
         calib = load_calibration(args.model_dir)
         if calib.get("node_threshold") is not None:
             cfg = _dc.replace(cfg, threshold=calib["node_threshold"])
+        try:
+            # the quality plane's own loader VALIDATES (schema ceiling,
+            # field shapes), so a malformed or newer-schema sidecar is a
+            # one-line downgrade to no-baseline here — drift monitoring
+            # is advisory and must never block serving
+            quality_profile = load_profile(args.model_dir)
+        except ValueError as e:
+            _log(f"quality profile unreadable ({e}); serving without a "
+                 f"drift baseline")
+            quality_profile = None
     else:
         _log("no --model-dir: serving an UNTRAINED small detector "
              "(load testing only — scores carry no meaning)")
@@ -953,6 +1064,10 @@ def cmd_serve_detect(args) -> int:
     service = OnlineDetectionService(params, model, cfg=cfg,
                                      compile_cache=compile_cache,
                                      executables_dir=executables_dir)
+    if quality_profile is not None:
+        # checkpoint-dir boot: bind the shipped drift baseline (registry
+        # boots get theirs through manager.attach below, version-stamped)
+        service.set_quality_profile(quality_profile)
     recorder = None
     uninstall_crash = None
     if args.flight_dir:
@@ -972,7 +1087,8 @@ def cmd_serve_detect(args) -> int:
             FlightConfig(out_dir=args.flight_dir,
                          p99_breach_sec=args.deadline_sec,
                          profile_on_p99_sec=args.profile_on_breach_sec),
-            info=service.flight_info, slo=service.slo, log=_log)
+            info=service.flight_info, slo=service.slo,
+            quality=service.quality_snapshot, log=_log)
         service.attach_flight(recorder)
         uninstall_crash = install_crash_handlers(recorder)
         _log(f"flight recorder armed: bundles in {args.flight_dir}"
@@ -1449,6 +1565,33 @@ def main(argv=None) -> int:
     chp = chsub.add_parser("example", help="print a commented-by-shape "
                                            "example plan to stdout")
     chp.set_defaults(fn=cmd_chaos)
+
+    p = sub.add_parser("quality", help="detection-quality plane: reference "
+                                       "profiles and drift tables "
+                                       "(docs/quality.md)")
+    qsub = p.add_subparsers(dest="quality_cmd", required=True)
+    qp = qsub.add_parser("show", help="render a reference profile "
+                                      "(checkpoint dir or profile JSON) "
+                                      "or a flight bundle's live "
+                                      "divergence table")
+    qp.add_argument("path", help="checkpoint dir / quality_profile.json / "
+                                 "flight bundle dir")
+    qp.add_argument("--json", action="store_true",
+                    help="machine-readable output")
+    qp.set_defaults(fn=cmd_quality)
+    qp = qsub.add_parser("compare", help="PSI two reference profiles: "
+                                         "score distribution, top-"
+                                         "drifting features, margin/"
+                                         "alert-rate deltas")
+    qp.add_argument("reference", help="the baseline profile "
+                                      "(checkpoint dir or JSON)")
+    qp.add_argument("other", help="the profile to judge against it")
+    qp.add_argument("--json", action="store_true",
+                    help="machine-readable output")
+    qp.add_argument("--psi-threshold", type=float, default=None,
+                    metavar="X", help="exit 1 when any PSI >= X "
+                                      "(CI gating)")
+    qp.set_defaults(fn=cmd_quality)
 
     p = sub.add_parser("cache", help="persistent compile cache: list, "
                                      "prune, verify, pre-warm")

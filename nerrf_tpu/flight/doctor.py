@@ -14,7 +14,12 @@ process, no scrape history.  The report has four sections:
   4. per-stage attribution — `nerrf trace`'s latency table over the
      bundled span ring (the same Chrome-trace file loads in Perfetto);
   5. SLO state — per-stream trailing p50/p99/breaches and budget burn
-     from the manifest's SLO snapshot, exemplar trace IDs included.
+     from the manifest's SLO snapshot, exemplar trace IDs included;
+  6. detection quality — the embedded ``quality.json`` (live trailing
+     sketches + the reference profile): per-stream score PSI and
+     alert-rate z, top-drifting window features, calibration margin mass
+     vs the reference — a ``quality_drift`` bundle is analyzable without
+     the pod, and any other bundle answers "was the model drifting".
 
 Unreadable pieces degrade per-section (a bundle written mid-crash may
 lack a file) — partial evidence beats no report.
@@ -74,6 +79,16 @@ def read_bundle(path) -> dict:
     from nerrf_tpu.devtime.capture import trace_summary
 
     out["profile"] = trace_summary(os.path.join(root, "jax_trace"))
+    # optional embedded quality snapshot (live drift sketches + reference
+    # profile) — bundles from profile-less versions simply lack it
+    out["quality"] = None
+    qpath = os.path.join(root, "quality.json")
+    if os.path.isfile(qpath):
+        try:
+            with open(qpath) as f:
+                out["quality"] = json.load(f)
+        except (OSError, ValueError):
+            out["missing"].append("quality.json")
     return out
 
 
@@ -215,7 +230,54 @@ def format_report(bundle: dict, tail: Optional[int] = None) -> str:
                     f"{k}={v:.0%}" for k, v in sorted(burn.items())))
     else:
         lines.append("SLO state: not recorded in manifest")
+
+    lines.append("")
+    lines.extend(quality_section(bundle.get("quality")))
     return "\n".join(lines)
+
+
+def quality_section(quality: Optional[dict]) -> List[str]:
+    """The drift report over an embedded ``quality.json`` snapshot — the
+    live-divergence table `nerrf doctor` and `nerrf quality show` share.
+    Degrades to one line when the bundle predates quality profiles."""
+    if not quality:
+        return ["detection quality: no quality.json in bundle "
+                "(live version predates profiles, or the plane is off)"]
+    ref = quality.get("reference") or {}
+    lines = [
+        f"detection quality (drift vs reference profile, "
+        f"version {quality.get('version') or '-'}):",
+        f"  reference: {ref.get('windows', 0)} windows / "
+        f"{ref.get('node_scores', 0)} node scores, threshold "
+        f"{_num(ref.get('threshold'))}, margin mass "
+        f"{_num(ref.get('margin_mass'))} (eps {_num(ref.get('margin_eps'))})",
+        f"  live: {quality.get('windows_observed', 0)} windows observed, "
+        f"margin mass {_num(quality.get('margin_mass'))}",
+    ]
+    per_stream = quality.get("per_stream") or {}
+    if per_stream:
+        lines.append(f"  {'stream':<18} {'windows':>7} {'scores':>8} "
+                     f"{'score_psi':>9} {'alert_z':>8}  p50/p90/p99")
+        for stream, s in sorted(
+                per_stream.items(),
+                key=lambda kv: -(kv[1].get("score_psi") or 0.0)):
+            q = s.get("score_quantiles") or {}
+            lines.append(
+                f"  {stream:<18} {s.get('windows', 0):>7} "
+                f"{s.get('scores', 0):>8} {_num(s.get('score_psi')):>9} "
+                f"{_num(s.get('alert_rate_z')):>8}  "
+                f"{_num(q.get('p50'))}/{_num(q.get('p90'))}/"
+                f"{_num(q.get('p99'))}")
+    else:
+        lines.append("  (no live streams sketched yet)")
+    feats = quality.get("features") or {}
+    drifting = sorted(((k, v.get("psi")) for k, v in feats.items()
+                       if v.get("psi") is not None),
+                      key=lambda t: -t[1])
+    if drifting:
+        lines.append("  top drifting features: " + ", ".join(
+            f"{k}={v:g}" for k, v in drifting[:8]))
+    return lines
 
 
 def _num(v) -> str:
@@ -240,6 +302,7 @@ def doctor_main(path, tail: Optional[int] = None, as_json: bool = False,
             "compile_provenance": compile_provenance(bundle["records"]),
             "span_events": len(bundle["events"]),
             "profile": bundle.get("profile"),
+            "quality": bundle.get("quality"),
             "missing": bundle["missing"],
         }, indent=2))
     else:

@@ -33,6 +33,9 @@ Record kinds in use (producers in parentheses):
     registry_promote  candidate promoted to LIVE (registry/manager)
     registry_veto     guardrail vetoed a candidate (registry/manager)
     registry_swap     live params hot-swapped, incl. rollbacks (registry/manager)
+    quality_reference a reference quality profile bound/cleared (quality/monitor)
+    quality_stats     cadenced drift stats: worst score/feature PSI, margin
+                      mass (quality/monitor; the quality_drift trigger edge)
     train_start/done  training-run config+model fingerprints (train/loop)
     exception         uncaught exception captured by the crash hook
     bundle            a flight-recorder bundle was written (flight/recorder)

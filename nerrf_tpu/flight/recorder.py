@@ -22,6 +22,12 @@ Triggers (all evaluated in-process, no scrape loop):
   * ``shadow_disagreement`` — a ``registry_shadow_stats`` journal record
     reports a disagreement rate above the spike threshold;
   * ``guardrail_veto``     — any ``registry_veto`` journal record;
+  * ``quality_drift``      — SUSTAINED distribution drift: the quality
+    monitor's cadenced ``quality_stats`` records report a score- or
+    feature-PSI above the breach threshold for N consecutive records
+    (min-window gated; one breaching record is noise, a streak is a
+    shift).  The bundle embeds the live sketches + reference profile
+    (``quality.json``) so the drift is analyzable offline;
   * ``exception``    — uncaught exception on any thread, via the
     `install_crash_handlers` sys/threading excepthook wrappers
     (+ `faulthandler` into the bundle directory for hard crashes).
@@ -84,6 +90,15 @@ class FlightConfig:
     # rate is single-batch noise, not an incident
     disagreement_spike: float = 0.35
     disagreement_min_windows: int = 8
+    # quality_drift: fires when quality_stats journal records report a
+    # worst score- OR feature-PSI at/above the breach value for
+    # quality_breach_records CONSECUTIVE records (the monitor cuts one
+    # per journal_every windows, so the streak is the "sustained" gate),
+    # each record carrying at least quality_min_windows observed windows.
+    # 0.25 is the conventional "major shift" PSI reading
+    quality_psi_breach: float = 0.25
+    quality_min_windows: int = 64
+    quality_breach_records: int = 3
     # OPT-IN p99-breach profiler capture (nerrf_tpu/devtime/capture.py):
     # when > 0, a p99_breach bundle additionally embeds this many seconds
     # of live jax.profiler trace under <bundle>/jax_trace/ — the scorer
@@ -101,7 +116,8 @@ class FlightRecorder:
     """Watches journal records + per-window latencies; dumps bundles."""
 
     def __init__(self, cfg: FlightConfig, registry=None, journal=None,
-                 tracer=None, slo=None, info=None, log=None) -> None:
+                 tracer=None, slo=None, info=None, quality=None,
+                 log=None) -> None:
         if registry is None:
             from nerrf_tpu.observability import DEFAULT_REGISTRY
 
@@ -118,6 +134,12 @@ class FlightRecorder:
         # info(): live model lineage / service identity for the manifest —
         # callable so the bundle captures the state AT dump time
         self._info = info or (lambda: {})
+        # quality(): the quality monitor's snapshot (live sketches +
+        # reference profile) — embedded as quality.json in every bundle
+        # when it returns one, so a drift bundle is self-contained and
+        # ANY bundle can answer "was the model drifting at the time"
+        self._quality = quality
+        self._quality_streak = 0
         self._log = log or (lambda msg: None)
         self._lock = threading.Lock()
         # dumps are serialized: concurrent triggers writing + the .tmp
@@ -200,6 +222,31 @@ class FlightRecorder:
                     "shadow_disagreement",
                     f"shadow disagreement rate {rate:.3f} >= "
                     f"{self.cfg.disagreement_spike:g}",
+                    context=dict(rec.data))
+        elif rec.kind == "quality_stats":
+            worst = max((v for v in (rec.data.get("worst_score_psi"),
+                                     rec.data.get("worst_feature_psi"))
+                         if v is not None), default=None)
+            windows = int(rec.data.get("windows", 0))
+            breach = (worst is not None
+                      and worst >= self.cfg.quality_psi_breach
+                      and windows >= self.cfg.quality_min_windows)
+            with self._lock:
+                # a streak of consecutive breaching records IS the
+                # "sustained" gate: one hot record between cadence points
+                # resets — drift persists, noise does not
+                self._quality_streak = self._quality_streak + 1 if breach \
+                    else 0
+                fire = self._quality_streak >= self.cfg.quality_breach_records
+                if fire:
+                    self._quality_streak = 0
+            if fire:
+                self.trigger(
+                    "quality_drift",
+                    f"PSI {worst:.3f} >= {self.cfg.quality_psi_breach:g} "
+                    f"sustained over {self.cfg.quality_breach_records} "
+                    f"consecutive quality_stats records "
+                    f"({windows} windows observed)",
                     context=dict(rec.data))
         elif rec.kind == "exception":
             self.trigger(
@@ -297,6 +344,15 @@ class FlightRecorder:
                            {"dir": None,
                             "error": "profiler capture failed (fail-open; "
                                      "see profile_failed journal record)"})
+            quality = _safe(self._quality) if self._quality is not None \
+                else None
+            if quality:
+                # the drift evidence: live trailing sketches + the full
+                # reference profile — mergeable counts, so offline
+                # analysis (and cross-host aggregation) recompute any
+                # divergence without the pod
+                with open(os.path.join(tmp, "quality.json"), "w") as f:
+                    json.dump(quality, f)
             records = self._journal.tail(self.cfg.journal_tail)
             with open(os.path.join(tmp, "journal.jsonl"), "w") as f:
                 for r in records:
@@ -319,6 +375,7 @@ class FlightRecorder:
                 "slo": self._slo.snapshot() if self._slo is not None
                        else None,
                 "profile": profile,
+                "quality": "quality.json" if quality else None,
                 "lineage": _safe(self._info),
                 "env": env_fingerprint(),
             }

@@ -17,6 +17,14 @@ Two signals, because they fail differently:
 Plus a trailing per-window **canary**: the last N windows must each stay
 under a (looser) disagreement cut, so a candidate that is fine on average
 but diverging on the newest traffic cannot promote.
+
+Both sides' score DISTRIBUTIONS are additionally sketched on the quality
+plane's mergeable fixed-bin primitive (`nerrf_tpu.quality.sketch` — the
+same maths the serve-side drift monitor runs), so the cadenced
+``registry_shadow_stats`` journal records carry score quantiles, not just
+means: a candidate whose mean drift is tiny while its tail walks toward
+the cut is visible in the record, and offline analysis can PSI the two
+sketches without replaying a single batch.
 """
 
 from __future__ import annotations
@@ -28,6 +36,7 @@ from typing import Optional
 
 import numpy as np
 
+from nerrf_tpu.quality.sketch import SCORE_EDGES, Sketch
 from nerrf_tpu.registry.config import RegistryConfig
 
 # verdicts
@@ -48,6 +57,12 @@ class ShadowStats:
     drift_sum: float = 0.0
     recent: deque = dataclasses.field(
         default_factory=lambda: deque(maxlen=64))
+    # score-distribution sketches over the paired real-node scores (the
+    # quality plane's mergeable primitive — one drift maths repo-wide)
+    live_sketch: Sketch = dataclasses.field(
+        default_factory=lambda: Sketch.empty(SCORE_EDGES))
+    shadow_sketch: Sketch = dataclasses.field(
+        default_factory=lambda: Sketch.empty(SCORE_EDGES))
     _lock: threading.Lock = dataclasses.field(
         default_factory=threading.Lock, repr=False)
 
@@ -66,6 +81,8 @@ class ShadowStats:
             self.disagreements += flips
             self.drift_sum += drift
             self.recent.append(flips / n if n else 0.0)
+            self.live_sketch.observe(lp)
+            self.shadow_sketch.observe(sp)
 
     @property
     def disagreement_rate(self) -> float:
@@ -87,6 +104,11 @@ class ShadowStats:
                     self.disagreements / nodes if nodes else 0.0,
                 "score_drift": self.drift_sum / nodes if nodes else 0.0,
                 "recent_window_rates": [round(r, 6) for r in self.recent],
+                # bin-resolution quantiles of both score distributions —
+                # a tail walking toward the cut shows here while the
+                # mean drift still reads healthy
+                "live_score_quantiles": self.live_sketch.quantiles(),
+                "shadow_score_quantiles": self.shadow_sketch.quantiles(),
             }
 
 

@@ -100,6 +100,7 @@ class ModelManager:
                     service._live_version = self._version
             version = self._version
         self._stamp_info(version)
+        self._push_quality_profile(version)
         return self
 
     @property
@@ -109,6 +110,23 @@ class ModelManager:
     @property
     def shadow_version(self) -> Optional[int]:
         return self._shadow_version
+
+    def _push_quality_profile(self, version: Optional[int]) -> None:
+        """Bind the version's reference quality profile to the service's
+        drift monitor — the serve plane must always compare live traffic
+        against the distribution of the version actually serving.  Best-
+        effort and tolerant of profile-less versions (the monitor then
+        exports nothing) and of skeleton services without the setter."""
+        setter = getattr(self._service, "set_quality_profile", None)
+        if setter is None:
+            return
+        try:
+            profile = (self.store.quality_profile(self.lineage, version)
+                       if version is not None else None)
+            setter(profile, version=version)
+        except Exception as e:  # noqa: BLE001 — drift plane is advisory
+            self._log(f"registry: quality profile bind for v{version} "
+                      f"failed: {type(e).__name__}: {e}")
 
     # -- metrics --------------------------------------------------------------
 
@@ -168,7 +186,12 @@ class ModelManager:
                 version=version,
                 windows=self._shadow_obs,
                 disagreement_rate=round(snap["disagreement_rate"], 4),
-                score_drift=round(snap["score_drift"], 4))
+                score_drift=round(snap["score_drift"], 4),
+                # score-distribution quantiles from the shared sketch
+                # primitive (quality/sketch): the record shows a tail
+                # walking toward the cut, not just the paired means
+                live_score_quantiles=snap["live_score_quantiles"],
+                shadow_score_quantiles=snap["shadow_score_quantiles"])
 
     # -- the poll step --------------------------------------------------------
 
@@ -311,6 +334,10 @@ class ModelManager:
             help="live param hot-swaps applied in-process (zero-recompile "
                  "pointer swaps under the batch lock)")
         self._stamp_info(version, previous=previous)
+        # the drift baseline moves WITH the model: from the next scored
+        # batch the monitor compares against the incoming version's
+        # reference (or goes silent for a profile-less version)
+        self._push_quality_profile(version)
         self._journal.record(
             "registry_swap", lineage=self.lineage, version=version,
             previous=previous, direction=direction, action=action)

@@ -122,6 +122,21 @@ class ModelRegistry:
         d = self.version_dir(lineage, version) / "executables"
         return d if (d / "manifest.json").is_file() else None
 
+    def quality_profile(self, lineage: str, version: int) -> Optional[dict]:
+        """The version's reference quality profile (the checkpoint's
+        ``quality_profile.json`` sidecar, published with the weights), or
+        None when the version predates profiles OR the sidecar is
+        unreadable — drift monitoring is advisory and must never block a
+        swap the way a corrupt model sidecar blocks a load (the serve
+        plane simply exports no quality series: null-not-fake)."""
+        from nerrf_tpu.quality import PROFILE_FILENAME
+
+        f = self.version_dir(lineage, int(version)) / PROFILE_FILENAME
+        try:
+            return json.loads(f.read_text())
+        except (OSError, json.JSONDecodeError, UnicodeDecodeError):
+            return None
+
     def status(self, lineage: str) -> dict:
         live = self.live(lineage)
         versions = []
@@ -141,6 +156,8 @@ class ModelRegistry:
                 "published_at": meta.get("published_at"),
                 "source": meta.get("published_from"),
                 "executables": self.executables_dir(lineage, v) is not None,
+                "quality_profile":
+                    self.quality_profile(lineage, v) is not None,
             })
         return {"lineage": lineage, "live": live, "versions": versions}
 

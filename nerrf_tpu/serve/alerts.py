@@ -69,6 +69,17 @@ class AlertSink:
         """Enqueue; False (and a counted overflow) when a stale alert was
         evicted to make room — the deque keeps the *newest* alerts, the
         same newest-evidence-wins policy as admission drop-oldest."""
+        # every emission counts BEFORE queueing outcomes: the quality
+        # plane's alert-rate z-score needs a contract-checked numerator
+        # (drops alone only ever measured the consumer).  BASE stream
+        # name: a resident stream's reconnect sessions (name#N) must not
+        # mint a label series per session
+        self._reg.counter_inc(
+            "serve_alerts_emitted_total",
+            labels={"stream": alert.stream.split("#", 1)[0]},
+            help="window alerts emitted at the demux boundary, by stream "
+                 "(pre-queue: the alert-rate numerator, independent of "
+                 "sink drops)")
         with self._lock:
             overflow = len(self._alerts) == self._alerts.maxlen
             evicted = self._alerts[0] if overflow else None

@@ -75,6 +75,12 @@ class WindowRequest:
     trace_id: str = ""
     t_packed: float = 0.0
     t_device: float = 0.0
+    # quality plane: the admission-side MEASURED window structure
+    # (pre-truncation node/edge/file counts) — the feature values the
+    # drift monitor sketches, carried so demux never re-measures
+    nodes: int = 0
+    edges: int = 0
+    files: int = 0
 
 
 @dataclasses.dataclass
@@ -104,6 +110,10 @@ class ScoredWindow:
     trace_id: str = ""
     t_packed: float = 0.0
     t_device: float = 0.0
+    # quality plane (mirrors WindowRequest): measured window structure
+    nodes: int = 0
+    edges: int = 0
+    files: int = 0
 
 
 class MicroBatcher:
@@ -416,7 +426,8 @@ class MicroBatcher:
                     node_key=s["node_key"], node_mask=s["node_mask"],
                     t_admit=r.t_admit, t_scored=now, late=late,
                     model_version=version, trace_id=r.trace_id,
-                    t_packed=r.t_packed, t_device=r.t_device))
+                    t_packed=r.t_packed, t_device=r.t_device,
+                    nodes=r.nodes, edges=r.edges, files=r.files))
                 r.sample = None  # release the padded sample's memory
             self._reg.counter_inc(
                 "serve_windows_scored_total", len(reqs),

@@ -94,6 +94,15 @@ class ServeConfig:
     # and leave() stops waiting, instead of every stream hanging on a
     # dead scorer thread; 0 disables
     scorer_wedge_sec: float = 60.0
+    # detection-quality plane (nerrf_tpu/quality): trailing score/feature
+    # drift sketches compared against the live version's reference
+    # profile, exported as nerrf_quality_* gauges + cadenced
+    # quality_stats journal records (the flight recorder's quality_drift
+    # trigger edge).  Host-side numpy at the demux boundary only; stays
+    # a single None check per window until a version with a profile is
+    # serving (null-not-fake); False drops the plane for minimal
+    # embedders
+    quality_monitoring: bool = True
     # device-efficiency plane (nerrf_tpu/devtime): live per-program MFU /
     # utilization / useful-FLOPs gauges and the capacity-headroom
     # predictor, fed from the scorer's measured device seconds.  Host-side

@@ -41,6 +41,7 @@ from nerrf_tpu.flight.journal import DEFAULT_JOURNAL, fingerprint, make_trace_id
 from nerrf_tpu.flight.slo import SLOTracker
 from nerrf_tpu.graph.builder import NODE_TYPE_FILE, measure_window
 from nerrf_tpu.models import NerrfNet
+from nerrf_tpu.quality import QualityMonitor
 from nerrf_tpu.pipeline import (
     DetectionResult,
     _inode_to_path,
@@ -102,6 +103,7 @@ class OnlineDetectionService:
         flight=None,
         compile_cache=None,
         executables_dir=None,
+        quality_monitor=None,
     ) -> None:
         if registry is None:
             from nerrf_tpu.observability import DEFAULT_REGISTRY
@@ -183,6 +185,15 @@ class OnlineDetectionService:
         self._devtime = (DeviceTimeAccountant(registry=registry,
                                               journal=self._journal)
                          if self.cfg.devtime_accounting else None)
+        # detection-quality plane (nerrf_tpu/quality): trailing
+        # score/feature drift sketches vs the live version's reference
+        # profile, fed at the demux boundary below.  Inactive (one None
+        # check per window) until set_quality_profile binds a reference —
+        # a version published before profiles existed exports nothing
+        self._quality = (quality_monitor if quality_monitor is not None
+                         else (QualityMonitor(registry=registry,
+                                              journal=self._journal)
+                               if self.cfg.quality_monitoring else None))
         # the background cost-registration thread (start()) + its stop
         # flag: stop() must be able to wait it out — a daemon thread
         # still inside jax tracing when the interpreter tears down is a
@@ -322,6 +333,27 @@ class OnlineDetectionService:
     @property
     def slo(self) -> SLOTracker:
         return self._slo
+
+    @property
+    def quality(self) -> Optional[QualityMonitor]:
+        """The drift monitor (None when disabled by config)."""
+        return self._quality
+
+    def set_quality_profile(self, profile, version=None) -> None:
+        """Bind the live version's reference quality profile (dict or
+        QualityProfile; None clears — a version published before
+        profiles stops all quality exports rather than comparing against
+        a stale reference).  Called by the ModelManager on attach/swap
+        and by the serve CLI at boot.  No-op when the plane is off."""
+        if self._quality is not None:
+            self._quality.set_reference(profile, version=version)
+
+    def quality_snapshot(self) -> Optional[dict]:
+        """Live sketches + reference, for flight bundles (`quality.json`)
+        and the bench artifact.  None when the plane is off or no
+        reference is bound (null-not-fake)."""
+        return (self._quality.snapshot()
+                if self._quality is not None else None)
 
     @property
     def devtime(self) -> Optional[DeviceTimeAccountant]:
@@ -820,7 +852,8 @@ class OnlineDetectionService:
                 stream=handle.id, window_idx=idx, lo_ns=lo, hi_ns=hi,
                 bucket=bucket, sample=sample, t_admit=now,
                 deadline=now + self.cfg.window_deadline_sec,
-                trace_id=trace_id)
+                trace_id=trace_id,
+                nodes=int(n), edges=int(e), files=int(files))
             dropped_old = None
             with handle.cond:
                 if len(handle.live) >= self.cfg.stream_queue_slots:
@@ -892,9 +925,17 @@ class OnlineDetectionService:
                     handle.cond.notify_all()
             # alerting: hot windows only, never blocking (bounded sink)
             mask = s.node_mask.astype(bool)
-            if not mask.any():
-                continue
-            hot_slots = np.nonzero(mask & (s.probs >= alert_thr))[0]
+            hot_slots = (np.nonzero(mask & (s.probs >= alert_thr))[0]
+                         if mask.any() else np.empty(0, np.int64))
+            if self._quality is not None:
+                # drift sketches at the demux boundary (base stream name:
+                # a resident stream's reconnect sessions are the same
+                # traffic population, not fresh label series)
+                self._quality.observe_window(
+                    _base_stream(s.stream), bucket_tag(s.bucket),
+                    s.probs, mask, s.node_type,
+                    nodes=s.nodes, edges=s.edges, files=s.files,
+                    alerted=bool(len(hot_slots)))
             if not len(hot_slots):
                 continue
             order = np.argsort(-s.probs[hot_slots], kind="stable")

@@ -145,7 +145,8 @@ def _check_feature_layout(meta: dict, path: Path, keys: tuple) -> None:
 
 
 def save_checkpoint(path: str | Path, params, cfg: JointConfig,
-                    calibration: dict | None = None) -> None:
+                    calibration: dict | None = None,
+                    quality_profile: dict | None = None) -> None:
     meta = {
         "gnn": {"hidden": cfg.gnn.hidden, "num_layers": cfg.gnn.num_layers,
                 "dropout": cfg.gnn.dropout,
@@ -167,6 +168,29 @@ def save_checkpoint(path: str | Path, params, cfg: JointConfig,
             with ocp.StandardCheckpointer() as ckptr:
                 ckptr.save(tmp / "params", jax.device_get(params), force=True)
         (tmp / "model_config.json").write_text(json.dumps(meta, indent=2))
+        if quality_profile:
+            # the reference quality profile rides the checkpoint as its
+            # own sidecar (nerrf_tpu/quality): the score/feature
+            # distribution this model was calibrated against, published
+            # with the weights so every serve pod can watch live traffic
+            # drift away from it.  Schema-versioned inside the document
+            from nerrf_tpu.quality import PROFILE_FILENAME
+
+            (tmp / PROFILE_FILENAME).write_text(
+                json.dumps(quality_profile, indent=2))
+
+
+def load_quality_profile(path: str | Path) -> dict | None:
+    """The checkpoint's reference quality profile sidecar, or None when
+    the checkpoint predates profiles — callers treat None as "export no
+    quality metrics" (null-not-fake), never as an empty distribution.
+    Delegates to the quality plane's one loader, so a malformed or
+    newer-schema sidecar fails HERE with the one-line ValueError every
+    caller already handles — not later inside a serving pod's monitor."""
+    from nerrf_tpu.quality import load_profile
+
+    prof = load_profile(Path(path).absolute())
+    return prof.to_dict() if prof is not None else None
 
 
 def load_checkpoint(path: str | Path) -> Tuple[dict, JointConfig]:
@@ -309,5 +333,28 @@ def calibrate_and_resave(path: str | Path, params, cfg: JointConfig,
         calibration.update({"node_threshold_robust": round(r.threshold, 4),
                             "node_threshold_robust_kind": r.kind,
                             "node_threshold_robust_recall": round(r.recall, 4)})
-    save_checkpoint(path, params, cfg, calibration=calibration)
+    # reference quality profile at the freshly calibrated operating point
+    # (nerrf_tpu/quality): the score/feature distribution this model +
+    # cut expects, stamped alongside the calibration so every serve pod
+    # watching this version has a drift baseline.  Best-effort, same
+    # contract as calibration itself — a failed profile never blocks the
+    # calibrated checkpoint
+    profile = None
+    try:
+        from nerrf_tpu.data.synth import make_corpus
+        from nerrf_tpu.quality import build_reference_profile
+
+        profile = build_reference_profile(
+            params, NerrfNet(cfg),
+            # held-out benign-weighted mix, seeds disjoint from both the
+            # training corpus and the calibration incidents (base 9000)
+            traces=make_corpus(4, attack_fraction=0.25, base_seed=9500,
+                               duration_sec=120.0),
+            threshold=calibration["node_threshold"], log=log).to_dict()
+    except Exception as e:  # noqa: BLE001 — profile is advisory
+        if log:
+            log(f"quality profile build failed ({type(e).__name__}: {e}); "
+                "checkpoint ships without a drift baseline")
+    save_checkpoint(path, params, cfg, calibration=calibration,
+                    quality_profile=profile)
     return calibration

@@ -38,7 +38,7 @@ DOCS = REPO / "docs"
 ORDER = ["index", "quick-start", "architecture", "models", "kernel-paths",
          "planner", "rollback", "ingest", "scaling", "configuration",
          "serving", "model-lifecycle", "compile-cache", "operations",
-         "device-efficiency", "flight-recorder", "chaos",
+         "device-efficiency", "flight-recorder", "quality", "chaos",
          "static-analysis", "benchmarks"]
 
 _CSS = """

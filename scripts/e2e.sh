@@ -77,6 +77,16 @@ timeout 560 env JAX_PLATFORMS=cpu python benchmarks/run_chaos_bench.py \
     --smoke > "$WORK/chaos_smoke.json"
 echo "e2e: chaos smoke survival gates pass"
 
+# pre-flight: quality drift-injection smoke — the detection-quality
+# plane end to end on the real serve path: the unshifted leg stays below
+# the PSI breach with single-stream bit-parity to model_detect, the
+# shifted leg fires exactly one doctor-readable quality_drift bundle
+# embedding both sketch sets (docs/quality.md).  Pinned to CPU: proves
+# the drift edge before any chip time is spent.
+timeout 560 env JAX_PLATFORMS=cpu python benchmarks/run_quality_bench.py \
+    --smoke > "$WORK/quality_smoke.json"
+echo "e2e: quality drift-injection smoke gates pass"
+
 # pre-flight: devtime smoke — the device-efficiency cost table (analytic
 # FLOPs / byte floor / roofline intensity for the serve ladder + flat
 # train step) resolves on CPU with every chip-relative column null

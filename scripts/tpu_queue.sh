@@ -52,6 +52,17 @@ then
   exit 1
 fi
 log "pre-flight: chaos smoke survival gates pass"
+# pre-flight: quality drift-injection smoke on CPU — injected
+# distribution shift fires exactly one doctor-readable quality_drift
+# bundle, unshifted traffic stays below the PSI breach with bit-parity
+# intact (docs/quality.md); runs BEFORE any tunnel time
+if ! timeout 560 env JAX_PLATFORMS=cpu python benchmarks/run_quality_bench.py \
+  --smoke > /tmp/quality_smoke.json 2>> /tmp/tpu_queue.log
+then
+  log "PRE-FLIGHT FAIL: quality drift-injection gates (/tmp/quality_smoke.json)"
+  exit 1
+fi
+log "pre-flight: quality drift-injection gates pass"
 # pre-flight: devtime cost table on CPU — the analytic cost model must
 # resolve for the whole serve ladder + train step with every
 # chip-relative column null (docs/device-efficiency.md); fails in

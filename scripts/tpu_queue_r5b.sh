@@ -39,6 +39,15 @@ then
   exit 1
 fi
 log "pre-flight: chaos smoke survival gates pass"
+# same quality pre-flight as tpu_queue.sh: the drift-injection gates
+# proven on CPU before chip time (docs/quality.md)
+if ! timeout 560 env JAX_PLATFORMS=cpu python benchmarks/run_quality_bench.py \
+  --smoke > /tmp/quality_smoke.json 2>> /tmp/tpu_queue.log
+then
+  log "PRE-FLIGHT FAIL: quality drift-injection gates (/tmp/quality_smoke.json)"
+  exit 1
+fi
+log "pre-flight: quality drift-injection gates pass"
 # same devtime pre-flight as tpu_queue.sh: the cost table must resolve
 # on CPU with chip-relative columns null (docs/device-efficiency.md)
 if ! timeout 300 env JAX_PLATFORMS=cpu python -m nerrf_tpu.cli profile costs \

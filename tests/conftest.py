@@ -93,6 +93,7 @@ def make_service_shell(cfg, registry=None, journal=None):
     svc.warmup_seconds = {}
     svc.warmup_source = {}
     svc._window_log = None
+    svc._quality = None
     svc._devtime = None
     svc._devtime_thread = None
     svc._devtime_stop = threading.Event()

@@ -11,7 +11,7 @@ def test_all_pages_present_and_linked(repo_root):
             "models.md", "planner.md", "rollback.md", "scaling.md",
             "operations.md", "benchmarks.md", "configuration.md",
             "flight-recorder.md", "chaos.md",
-            "device-efficiency.md"} <= pages
+            "device-efficiency.md", "quality.md"} <= pages
     # every relative .md link in every page resolves
     for p in docs.glob("*.md"):
         for target in re.findall(r"\]\(([\w\-]+\.md)\)", p.read_text()):
@@ -26,7 +26,8 @@ def test_referenced_cli_commands_exist(repo_root):
     referenced = set(re.findall(r"nerrf_tpu\.cli (\w[\w-]*)", text))
     parser_cmds = {"simulate", "train-detector", "undo", "status", "serve",
                    "serve-detect", "ingest", "trace", "warmup", "doctor",
-                   "models", "lint", "cache", "chaos", "profile"}
+                   "models", "lint", "cache", "chaos", "profile",
+                   "quality"}
     assert referenced <= parser_cmds
     # and the parser really accepts them
     for cmd in parser_cmds:
