@@ -7,9 +7,13 @@ Prometheus contract) each became a bug once; every rule here is the
 generalized regression test for one of those bug classes, wired into
 tier-1 so every future PR is analyzed on every test run.
 
-Two tiers: the AST rules here, and the deep (jaxpr-level) program
-contracts in ``nerrf_tpu/analysis/programs/`` — abstract tracing of the
-real serve/train/parallel entry points behind ``nerrf lint --deep``
+Three tiers: the AST rules here (purity / recompile / sync / lock
+discipline / metrics), the concurrency tier
+(``nerrf_tpu/analysis/concurrency.py`` — atomicity, callbacks and
+blocking work under locks, thread lifecycle — built on the shared lock
+model in ``locks.py``), and the deep (jaxpr-level) program contracts in
+``nerrf_tpu/analysis/programs/`` — abstract tracing of the real
+serve/train/parallel entry points behind ``nerrf lint --deep``
 (signature closure, donation discipline, collective/sharding
 consistency, Pallas VMEM budgets, cache-key coverage).
 

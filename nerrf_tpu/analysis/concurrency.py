@@ -1,0 +1,668 @@
+"""The concurrency tier of nerrflint: atomicity, callbacks, blocking, threads.
+
+PR 5's ``lock-discipline`` answers "is this attribute touched under its
+lock"; these rules answer the questions the threaded planes actually got
+wrong in review, on top of the same shared lock model
+(`locks.build_lock_model` — identical guard inference, entry-held
+propagation and lock-region ids):
+
+  * ``atomicity-violation`` — a guarded attribute is checked in one
+    atomic region and acted on in another (``if self._x: … with
+    self._lock: use self._x``, or read-modify-write split across two
+    separately-locked blocks).  Each region is individually locked, but
+    the value can change in the gap; correct code either widens the lock
+    or re-validates inside the second region — and says so inline.
+  * ``callback-under-lock`` — a listener / injected callback / user
+    function is invoked while a lock is held.  The journal's "fan-out
+    outside the lock" contract, machine-enforced: a slow or re-entrant
+    callback under a lock serializes unrelated producers at best and
+    deadlocks at worst.
+  * ``blocking-under-lock`` — sleep, thread join, device sync
+    (`block_until_ready`/`device_get`/`sync_result`/bare ``.item()``),
+    file IO or network/subprocess work statically reachable while a lock
+    is held (cross-module, via the project call graph).  Everything
+    waiting on that lock waits on the disk/device too.
+  * ``thread-lifecycle`` — every ``threading.Thread`` must carry a
+    ``name=`` (journal records, the stuck-scorer watchdog and
+    `faulthandler` dumps attribute by thread name); jax-reachable work on
+    a ``daemon=True`` thread is flagged (a daemon thread still inside jax
+    tracing at interpreter teardown segfaults the process — the class of
+    bug `OnlineDetectionService.stop` joins its cost thread to avoid);
+    and a thread stored on ``self`` must be joined by some method of its
+    class (the matching ``stop()``/``close()``), or justified.
+
+All four flow through the standard Finding/suppression/baseline
+machinery; anchors are name-derived, never line numbers.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
+
+from nerrf_tpu.analysis.astutil import (
+    FunctionInfo,
+    ModuleInfo,
+    Project,
+    body_nodes,
+    dotted,
+    own_calls,
+)
+from nerrf_tpu.analysis.engine import Finding, Rule
+from nerrf_tpu.analysis.locks import (
+    _ClassInfo,
+    build_lock_model,
+    in_scope,
+    infer_guards,
+)
+
+
+def _canonical(call: ast.Call, mod: Optional[ModuleInfo]) -> Optional[str]:
+    """Dotted call-target name, canonicalized through the module's
+    import-alias table (``import time as _t`` cannot hide a sleep)."""
+    d = dotted(call.func)
+    if d is None:
+        return None
+    parts = d.split(".")
+    if mod is not None:
+        full = mod.imports.get(parts[0])
+        if full:
+            parts = full.split(".") + parts[1:]
+    return ".".join(parts)
+
+
+def resolve_name_chain(project: Project, mod: ModuleInfo, name: str,
+                       depth: int = 0) -> List[FunctionInfo]:
+    """`Project._resolve_name` plus re-export following: a name imported
+    from a package ``__init__`` that itself imports it from a submodule
+    (``from nerrf_tpu.devtime import program_cost``) resolves to the real
+    definition.  Bounded — a cycle of re-exports resolves to nothing."""
+    if depth > 4:
+        return []
+    hits = project._resolve_name(mod, name)
+    if hits:
+        return hits
+    full = mod.imports.get(name)
+    if full and "." in full:
+        src_mod, _, attr = full.rpartition(".")
+        target = project.modules.get(src_mod)
+        if target is not None and target is not mod:
+            hits = resolve_name_chain(project, target, attr, depth + 1)
+            if hits:
+                return hits
+            # lazily re-exporting package (PEP 562 __getattr__, the
+            # devtime idiom): no static import to follow, so fall back to
+            # the package's submodules — accept only a UNIQUE
+            # module-level definition (ambiguity resolves to nothing)
+            cands = [
+                f for name2, m2 in project.modules.items()
+                if name2.startswith(src_mod + ".")
+                for f in m2.by_name.get(attr, [])
+                if "." not in f.qualname]
+            if len(cands) == 1:
+                return cands
+    return []
+
+
+# -- atomicity-violation ------------------------------------------------------
+
+
+class AtomicityViolation(Rule):
+    id = "atomicity-violation"
+    description = ("check-then-act / read-modify-write on a lock-guarded "
+                   "attribute split across separately-locked regions")
+
+    def __init__(self, scope: Optional[Tuple[str, ...]] = None) -> None:
+        self.scope = scope
+
+    def run(self, project: Project) -> List[Finding]:
+        out: List[Finding] = []
+        for ci in build_lock_model(project, self.scope):
+            if ci.locks:
+                out.extend(self._check_class(ci))
+        return out
+
+    def _check_class(self, ci: _ClassInfo) -> List[Finding]:
+        guards, _containers = infer_guards(ci)
+        if not guards:
+            return []
+        # method → guarded attrs it writes with the guard held (so a call
+        # to self.mark_warm() counts as the "act" half in its caller)
+        locked_writes: Dict[str, Set[str]] = {}
+        for a in ci.accesses:
+            held = a.held | ci.entry.get(a.method, frozenset())
+            if a.kind in ("mutate", "rebind") and a.attr in guards \
+                    and held & guards[a.attr]:
+                locked_writes.setdefault(a.method, set()).add(a.attr)
+        out: List[Finding] = []
+        for mname in ci.methods:
+            if mname == "__init__":
+                continue
+            entry = ci.entry.get(mname, frozenset())
+            accesses = [a for a in ci.accesses
+                        if a.method == mname and a.attr in guards]
+            # acts via intra-class calls (self.mark_warm() is the "act"
+            # half in _score_batch): when the caller already holds the
+            # guard at the call site the callee runs inside the caller's
+            # atomic region (keep the lexical region); when it does not,
+            # the callee re-locks on its own — a separate region by
+            # construction (synthetic negative id)
+            call_acts: List[Tuple[str, int, int]] = []
+            for c in ci.calls:
+                if c.method != mname or c.bare or \
+                        c.callee not in locked_writes:
+                    continue
+                held_at_call = c.held | entry
+                for attr in locked_writes[c.callee]:
+                    if held_at_call & guards.get(attr, set()):
+                        call_acts.append((attr, c.line, c.region))
+                    else:
+                        call_acts.append((attr, c.line, -c.line))
+            for attr in sorted({a.attr for a in accesses}
+                               | {t[0] for t in call_acts}):
+                g = guards[attr]
+                if entry & g:
+                    continue  # whole method runs under the guard: atomic
+                acts = [(a.line, a.region) for a in accesses
+                        if a.attr == attr
+                        and a.kind in ("mutate", "rebind")
+                        and (a.held | entry) & g]
+                acts += [(ln, rg) for at, ln, rg in call_acts if at == attr]
+                if not acts:
+                    continue
+                checks = [(a.line, a.region) for a in accesses
+                          if a.attr == attr and a.kind == "read"]
+                hit = next(
+                    ((c, t) for c in checks for t in acts
+                     if c[0] < t[0] and c[1] != t[1]), None)
+                if hit is None:
+                    continue
+                (c_line, _), (t_line, _) = hit
+                lock = "/".join(sorted(g))
+                out.append(Finding(
+                    rule=self.id, path=ci.mod.path, line=t_line,
+                    message=f"{ci.name}.{mname} checks {ci.name}.{attr} "
+                            f"(line {c_line}) and acts on it under "
+                            f"self.{lock} (line {t_line}) in a separate "
+                            f"atomic region — the value can change "
+                            f"between the two",
+                    hint=f"widen one `with self.{lock}:` over the whole "
+                         f"check-then-act sequence, or re-validate under "
+                         f"the lock and justify inline why staleness is "
+                         f"benign",
+                    anchor=f"{ci.name}.{mname}:{attr}:split"))
+        return out
+
+
+# -- callback-under-lock ------------------------------------------------------
+
+# attribute names that denote injected/observer callables by convention
+_CB_ATTR = re.compile(r"(listener|callback|subscriber|hook)|^_?on_")
+# container attrs whose ELEMENTS are callbacks (fan-out lists)
+_CB_CONTAINER = re.compile(r"(listener|callback|subscriber|hook)s?$")
+
+
+class CallbackUnderLock(Rule):
+    id = "callback-under-lock"
+    description = ("listeners / injected callbacks / user-supplied "
+                   "functions invoked while holding a lock")
+
+    def __init__(self, scope: Optional[Tuple[str, ...]] = None) -> None:
+        self.scope = scope
+
+    def run(self, project: Project) -> List[Finding]:
+        out: List[Finding] = []
+        for ci in build_lock_model(project, self.scope):
+            if ci.locks:
+                out.extend(self._check_class(ci))
+        return out
+
+    def _callback_attrs(self, ci: _ClassInfo) -> Set[str]:
+        """Attrs that hold injected callables: assigned from a parameter
+        of the defining method AND called directly somewhere, or matching
+        the callback naming convention.  Only true ``self.X(...)`` calls
+        qualify — foreign ``obj.x()`` sites (recorded as ``*.x``) are
+        another object's business and would mangle anchors."""
+        called = {c.callee for c in ci.calls
+                  if not c.bare and not c.callee.startswith("*.")
+                  and c.callee not in ci.methods}
+        out = {a for a in called if _CB_ATTR.search(a)}
+        for mname, mnode in ci.methods.items():
+            params = set()
+            if isinstance(mnode, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                args = mnode.args
+                params = {p.arg for p in
+                          (args.posonlyargs + args.args + args.kwonlyargs)
+                          if p.arg != "self"}
+            for node in body_nodes(mnode):
+                if not isinstance(node, ast.Assign):
+                    continue
+                for t in node.targets:
+                    if isinstance(t, ast.Attribute) and \
+                            isinstance(t.value, ast.Name) and \
+                            t.value.id == "self" and t.attr in called:
+                        names = {n.id for n in ast.walk(node.value)
+                                 if isinstance(n, ast.Name)}
+                        if names & params:
+                            out.add(t.attr)
+        return out
+
+    def _check_class(self, ci: _ClassInfo) -> List[Finding]:
+        cb_attrs = self._callback_attrs(ci)
+        # local names bound (directly or transitively) from a fan-out
+        # container attr: `listeners = list(self._listeners)`,
+        # `for fn in self._listeners:` — calling such a name under a lock
+        # is calling the listeners under the lock
+        tainted: Dict[str, Dict[str, str]] = {}
+        for mname, mnode in ci.methods.items():
+            t: Dict[str, str] = {}
+            for node in body_nodes(mnode):
+                src = None
+                if isinstance(node, ast.Assign):
+                    src = self._cb_source(node.value, t)
+                    targets = node.targets
+                elif isinstance(node, ast.For):
+                    src = self._cb_source(node.iter, t)
+                    targets = [node.target]
+                else:
+                    continue
+                if src:
+                    for tg in targets:
+                        if isinstance(tg, ast.Name):
+                            t[tg.id] = src
+            tainted[mname] = t
+        out: List[Finding] = []
+        seen = set()
+        for c in ci.calls:
+            held = c.held | ci.entry.get(c.method, frozenset())
+            if not held:
+                continue
+            via = None
+            if not c.bare and c.callee in cb_attrs:
+                via = f"self.{c.callee}"
+            elif c.bare and c.callee in tainted.get(c.method, {}):
+                via = (f"{c.callee} (from self."
+                       f"{tainted[c.method][c.callee]})")
+            if via is None:
+                continue
+            key = (ci.name, c.method, c.callee)
+            if key in seen:
+                continue
+            seen.add(key)
+            lock = "/".join(sorted(h.lstrip("~") for h in held))
+            out.append(Finding(
+                rule=self.id, path=ci.mod.path, line=c.line,
+                message=f"{ci.name}.{c.method} invokes callback {via} "
+                        f"while holding {lock} — a slow or re-entrant "
+                        f"callback stalls every thread behind the lock",
+                hint="snapshot the callback list under the lock, release, "
+                     "then fan out (the EventJournal.record pattern), or "
+                     "justify inline why this callable can never block or "
+                     "re-enter",
+                anchor=f"{ci.name}.{c.method}:{c.callee}:callback"))
+        return out
+
+    def _cb_source(self, expr: ast.AST, tainted: Dict[str, str]
+                   ) -> Optional[str]:
+        for n in ast.walk(expr):
+            if isinstance(n, ast.Attribute) and \
+                    isinstance(n.value, ast.Name) and n.value.id == "self" \
+                    and _CB_CONTAINER.search(n.attr):
+                return n.attr
+            if isinstance(n, ast.Name) and n.id in tainted:
+                return tainted[n.id]
+        return None
+
+
+# -- blocking-under-lock ------------------------------------------------------
+
+_OS_BLOCKING = frozenset({
+    "replace", "rename", "makedirs", "utime", "remove", "unlink", "rmdir",
+    "listdir", "fsync", "stat",
+})
+_FILE_METHODS = frozenset({
+    "write_text", "write_bytes", "read_text", "read_bytes",
+})
+_SYNC_CALLS = frozenset({"block_until_ready", "sync_result"})
+
+
+def blocking_effect(call: ast.Call, mod: Optional[ModuleInfo]
+                    ) -> Optional[str]:
+    """→ display name when this call blocks (sleep / thread join / device
+    sync / file IO / network+subprocess), else None."""
+    d = _canonical(call, mod)
+    if d is None:
+        return None
+    parts = d.split(".")
+    last = parts[-1]
+    if d in ("time.sleep", "sleep"):
+        return "time.sleep"
+    if last == "join":
+        recv = ".".join(parts[:-1])
+        if "thread" in recv.lower() or \
+                any(kw.arg == "timeout" for kw in call.keywords):
+            return d  # thread join ("".join stays out: no timeout=)
+        return None
+    if last in _SYNC_CALLS:
+        return last
+    if d in ("jax.device_get", "device_get"):
+        return "jax.device_get"
+    if last == "item" and not call.args and not call.keywords:
+        return ".item()"
+    if d == "open":
+        return "open"
+    if parts[0] in ("shutil", "subprocess", "socket", "requests", "grpc",
+                    "urllib", "tempfile") and len(parts) > 1:
+        return d
+    if parts[0] == "os" and last in _OS_BLOCKING:
+        return d
+    if d in ("json.dump", "pickle.dump"):
+        return d
+    if last in _FILE_METHODS:
+        return d
+    return None
+
+
+class BlockingUnderLock(Rule):
+    id = "blocking-under-lock"
+    description = ("sleep / thread join / device sync / file IO / network "
+                   "reachable while a lock is held (cross-module walk)")
+
+    _MAX_DEPTH = 8
+
+    def __init__(self, scope: Optional[Tuple[str, ...]] = None) -> None:
+        self.scope = scope
+
+    def run(self, project: Project) -> List[Finding]:
+        out: List[Finding] = []
+        for ci in build_lock_model(project, self.scope):
+            if ci.locks:
+                out.extend(self._check_class(project, ci))
+        return out
+
+    def _check_class(self, project: Project, ci: _ClassInfo
+                     ) -> List[Finding]:
+        mod = ci.mod
+        memo: Dict[int, Optional[Tuple[str, str]]] = \
+            getattr(project, "_blocking_memo", None) or {}
+        project._blocking_memo = memo
+        # (method, lock set) → [(effect, via, line)], aggregated so one
+        # justification covers one method's deliberate IO-under-lock
+        grouped: Dict[Tuple[str, str], List[Tuple[str, str, int]]] = {}
+        for c in ci.calls:
+            held = c.held | ci.entry.get(c.method, frozenset())
+            if not held:
+                continue
+            eff = blocking_effect(c.node, mod)
+            via = ""
+            if eff is None:
+                if not c.bare and c.callee in ci.methods and \
+                        ci.entry.get(c.callee, frozenset()):
+                    # entry-held sibling method: it reports its own
+                    # blocking under its own anchor — one justification
+                    # per method, not one per caller
+                    continue
+                caller = mod.methods.get((ci.name, c.method))
+                for callee in self._resolve(project, mod, caller, c.node):
+                    hit = self._walk(project, callee, memo, 0)
+                    if hit is not None and hit is not self._TRUNC:
+                        eff, path = hit
+                        via = f" via {path}"
+                        break
+            if eff is None:
+                continue
+            lock = "/".join(sorted(h.lstrip("~") for h in held))
+            grouped.setdefault((c.method, lock), []).append(
+                (eff, via, c.line))
+        out: List[Finding] = []
+        for (mname, lock), effs in sorted(grouped.items()):
+            effs.sort(key=lambda e: e[2])
+            uniq = list(dict.fromkeys((e, v) for e, v, _ in effs))
+            shown = ", ".join(f"{e}{v}" for e, v in uniq[:3])
+            more = f" (+{len(uniq) - 3} more)" if len(uniq) > 3 else ""
+            out.append(Finding(
+                rule=self.id, path=ci.mod.path, line=effs[0][2],
+                message=f"{ci.name}.{mname} blocks while holding {lock}: "
+                        f"{shown}{more} — every thread waiting on the "
+                        f"lock waits on this too",
+                hint="move the blocking work outside the lock (snapshot "
+                     "state, release, then do IO), or justify inline why "
+                     "serializing it under this lock is the design",
+                anchor=f"{ci.name}.{mname}:{lock}:blocking"))
+        return out
+
+    def _resolve(self, project: Project, mod: ModuleInfo,
+                 caller: Optional[FunctionInfo], call: ast.Call
+                 ) -> List[FunctionInfo]:
+        hits = project.resolve_call(mod, caller, call)
+        if hits:
+            return hits
+        d = dotted(call.func)
+        if d is not None and "." not in d:
+            return resolve_name_chain(project, mod, d)
+        return []
+
+    # sentinel: the walk hit the depth cap somewhere below, so a None
+    # verdict is INCOMPLETE and must not be memoized — a shallower entry
+    # point reaching the same function still deserves a full walk
+    _TRUNC = ("<truncated>", "<truncated>")
+
+    def _walk(self, project: Project, fi: FunctionInfo,
+              memo: Dict[int, Optional[Tuple[str, str]]], depth: int
+              ) -> Optional[Tuple[str, str]]:
+        key = id(fi.node)
+        if key in memo:
+            return memo[key]
+        if depth > self._MAX_DEPTH:
+            return self._TRUNC
+        memo[key] = None  # cycle guard
+        mod = project.module_of(fi)
+        for call in own_calls(fi.node):
+            eff = blocking_effect(call, mod)
+            if eff is not None:
+                memo[key] = (eff, fi.qualname)
+                return memo[key]
+        truncated = False
+        for call in own_calls(fi.node):
+            for callee in self._resolve(project, mod, fi, call):
+                hit = self._walk(project, callee, memo, depth + 1)
+                if hit is self._TRUNC:
+                    truncated = True
+                    continue
+                if hit is not None:
+                    memo[key] = (hit[0], f"{fi.qualname} -> {hit[1]}")
+                    return memo[key]
+        if truncated:
+            del memo[key]  # incomplete verdict: never cache it
+            return self._TRUNC
+        return None
+
+
+# -- thread-lifecycle ---------------------------------------------------------
+
+
+class ThreadLifecycle(Rule):
+    id = "thread-lifecycle"
+    description = ("threading.Thread sites: unnamed threads, jax-reachable "
+                   "work on daemon threads, self-held threads no "
+                   "stop()/close() joins")
+
+    _MAX_DEPTH = 10
+
+    def __init__(self, scope: Optional[Tuple[str, ...]] = None) -> None:
+        self.scope = scope
+
+    def run(self, project: Project) -> List[Finding]:
+        out: List[Finding] = []
+        for mod in project.modules.values():
+            if in_scope(mod, self.scope):
+                out.extend(self._check_module(project, mod))
+        return out
+
+    # -- per-module sweep -----------------------------------------------------
+
+    def _check_module(self, project: Project, mod: ModuleInfo
+                      ) -> List[Finding]:
+        out: List[Finding] = []
+        ordinals: Dict[str, int] = {}
+        # class → [(attr, fi)] for self-held threads (join audit)
+        held: Dict[str, List[Tuple[str, FunctionInfo]]] = {}
+        for fi in mod.functions:
+            for node in body_nodes(fi.node):
+                if not isinstance(node, ast.Call) or \
+                        _canonical(node, mod) != "threading.Thread":
+                    continue
+                ordinals[fi.qualname] = ordinals.get(fi.qualname, 0) + 1
+                suffix = f"@{ordinals[fi.qualname]}" \
+                    if ordinals[fi.qualname] > 1 else ""
+                kw = {k.arg: k.value for k in node.keywords if k.arg}
+                if "name" not in kw:
+                    out.append(Finding(
+                        rule=self.id, path=mod.path, line=node.lineno,
+                        message=f"unnamed threading.Thread in "
+                                f"{fi.qualname} — journal records, the "
+                                f"scorer watchdog and faulthandler dumps "
+                                f"attribute by thread name",
+                        hint="pass name=\"nerrf-<subsystem>-<role>\"",
+                        anchor=f"{fi.qualname}:thread:unnamed{suffix}"))
+                daemon = isinstance(kw.get("daemon"), ast.Constant) and \
+                    kw["daemon"].value is True
+                target = kw.get("target")
+                if daemon and target is not None:
+                    hit = self._target_touches_jax(project, mod, fi,
+                                                   target)
+                    if hit is not None:
+                        out.append(Finding(
+                            rule=self.id, path=mod.path, line=node.lineno,
+                            message=f"daemon=True thread in {fi.qualname} "
+                                    f"runs jax-reachable work ({hit}) — a "
+                                    f"daemon thread still inside jax at "
+                                    f"interpreter teardown segfaults the "
+                                    f"process",
+                            hint="make the thread non-daemon and join it "
+                                 "(bounded) in the matching stop()/"
+                                 "close(), or move the jax work off the "
+                                 "thread",
+                            anchor=f"{fi.qualname}:thread:"
+                                   f"daemon-jax{suffix}"))
+                attr = self._self_target_attr(fi, node)
+                if attr is not None and fi.cls is not None:
+                    held.setdefault(fi.cls, []).append((attr, fi))
+        out.extend(self._join_audit(mod, held))
+        return out
+
+    # -- jax reachability -----------------------------------------------------
+
+    def _target_touches_jax(self, project: Project, mod: ModuleInfo,
+                            fi: FunctionInfo, target: ast.AST
+                            ) -> Optional[str]:
+        for cand in self._resolve_target(project, mod, fi, target):
+            hit = self._touches_jax(project, cand, set(), 0)
+            if hit is not None:
+                return hit
+        return None
+
+    def _resolve_target(self, project: Project, mod: ModuleInfo,
+                        fi: FunctionInfo, target: ast.AST
+                        ) -> List[FunctionInfo]:
+        d = dotted(target)
+        if d is None:
+            return []
+        parts = d.split(".")
+        if len(parts) == 1:
+            return resolve_name_chain(project, mod, parts[0])
+        if parts[0] == "self" and len(parts) == 2 and fi.cls is not None:
+            hit = mod.methods.get((fi.cls, parts[1]))
+            return [hit] if hit else []
+        full = mod.imports.get(parts[0])
+        target_mod = project.modules.get(full) if full else None
+        if target_mod is not None and len(parts) == 2:
+            return [f for f in target_mod.by_name.get(parts[1], [])
+                    if "." not in f.qualname]
+        return []
+
+    def _touches_jax(self, project: Project, fi: FunctionInfo,
+                     seen: Set[int], depth: int) -> Optional[str]:
+        if depth > self._MAX_DEPTH or id(fi.node) in seen:
+            return None
+        seen.add(id(fi.node))
+        mod = project.module_of(fi)
+        for call in own_calls(fi.node):
+            d = _canonical(call, mod)
+            if d is not None and d.split(".")[0] in ("jax", "jaxlib"):
+                return f"{d} in {fi.qualname}"
+        for call in own_calls(fi.node):
+            cands = project.resolve_call(mod, fi, call)
+            if not cands:
+                d = dotted(call.func)
+                if d is not None and "." not in d:
+                    cands = resolve_name_chain(project, mod, d)
+            for callee in cands:
+                hit = self._touches_jax(project, callee, seen, depth + 1)
+                if hit is not None:
+                    return hit
+        return None
+
+    # -- join audit -----------------------------------------------------------
+
+    def _self_target_attr(self, fi: FunctionInfo, thread_call: ast.Call
+                          ) -> Optional[str]:
+        """The self attr this Thread lands on (`self._t = Thread(...)`,
+        `self._threads = [Thread(...), ...]`,
+        `self._threads.append(Thread(...))`) — else None."""
+        for node in body_nodes(fi.node):
+            if isinstance(node, ast.Assign) and any(
+                    n is thread_call for n in ast.walk(node)):
+                for t in node.targets:
+                    if isinstance(t, ast.Attribute) and \
+                            isinstance(t.value, ast.Name) and \
+                            t.value.id == "self":
+                        return t.attr
+            if isinstance(node, ast.Call) and node is not thread_call \
+                    and any(n is thread_call for n in ast.walk(node)) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in ("append", "add"):
+                recv = node.func.value
+                if isinstance(recv, ast.Attribute) and \
+                        isinstance(recv.value, ast.Name) and \
+                        recv.value.id == "self":
+                    return recv.attr
+        return None
+
+    def _join_audit(self, mod: ModuleInfo,
+                    held: Dict[str, List[Tuple[str, FunctionInfo]]]
+                    ) -> List[Finding]:
+        out: List[Finding] = []
+        for cls, entries in sorted(held.items()):
+            # methods of the class that both reference self.<attr> and
+            # call .join(...) are the joiners
+            joiners: Dict[str, Set[str]] = {}
+            for (c, m), mfi in mod.methods.items():
+                if c != cls:
+                    continue
+                joins = any(isinstance(n, ast.Call)
+                            and isinstance(n.func, ast.Attribute)
+                            and n.func.attr == "join"
+                            for n in body_nodes(mfi.node))
+                if not joins:
+                    continue
+                attrs = {n.attr for n in ast.walk(mfi.node)
+                         if isinstance(n, ast.Attribute)
+                         and isinstance(n.value, ast.Name)
+                         and n.value.id == "self"}
+                for a in attrs:
+                    joiners.setdefault(a, set()).add(m)
+            for attr, fi in sorted({a: f for a, f in entries}.items()):
+                if attr in joiners:
+                    continue
+                out.append(Finding(
+                    rule=self.id, path=mod.path, line=fi.line,
+                    message=f"{cls}.{attr} holds a thread started in "
+                            f"{fi.qualname} but no method of {cls} joins "
+                            f"it — stop()/close() leaves it running",
+                    hint="join the thread (bounded timeout) in the "
+                         "matching stop()/close(), or justify inline why "
+                         "its lifetime is externally owned",
+                    anchor=f"{cls}:{attr}:unjoined"))
+        return out

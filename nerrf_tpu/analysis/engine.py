@@ -101,6 +101,12 @@ class Rule:
 def default_rules() -> List[Rule]:
     """The full shipped ruleset (import here, not at module top, so the
     engine itself stays importable from rule modules)."""
+    from nerrf_tpu.analysis.concurrency import (
+        AtomicityViolation,
+        BlockingUnderLock,
+        CallbackUnderLock,
+        ThreadLifecycle,
+    )
     from nerrf_tpu.analysis.locks import LockDiscipline
     from nerrf_tpu.analysis.metrics_contract import MetricsContract
     from nerrf_tpu.analysis.purity import JaxPurity
@@ -108,7 +114,8 @@ def default_rules() -> List[Rule]:
     from nerrf_tpu.analysis.syncs import SyncInHotLoop
 
     return [JaxPurity(), RecompileHazard(), SyncInHotLoop(),
-            LockDiscipline(), MetricsContract()]
+            LockDiscipline(), AtomicityViolation(), CallbackUnderLock(),
+            BlockingUnderLock(), ThreadLifecycle(), MetricsContract()]
 
 
 # -- baseline -----------------------------------------------------------------

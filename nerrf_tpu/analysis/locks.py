@@ -1,8 +1,10 @@
 """lock-discipline: shared state in the threaded planes stays under lock.
 
-Scope: the threaded serve/registry/observability code — per-class analysis
-of ``self.X`` accesses against the class's own ``threading.Lock`` /
-``RLock`` / ``Condition`` attributes (constructor-assigned or dataclass
+Scope: the whole package (widened from serve/registry/observability when
+the flight/chaos/quality/devtime/compilecache planes landed threaded
+state of their own) — per-class analysis of ``self.X`` accesses against
+the class's own ``threading.Lock`` / ``RLock`` / ``Condition`` attributes
+(constructor-assigned or dataclass
 ``field(default_factory=threading.Lock)``).
 
 The discipline inferred, per class:
@@ -25,8 +27,19 @@ The discipline inferred, per class:
 
 Plus the **lock-acquisition-order graph**: an edge L→M whenever M is
 acquired (lexically, or through a call to a uniquely-named method of a
-scanned class that acquires M) while L is held.  A cycle means two
-threads can deadlock batcher↔manager↔registry; any cycle is a finding.
+scanned class that acquires M) while L is held.  The acquisition sets
+close transitively across class boundaries through the project call
+index — batcher → journal → recorder chains are edges the per-class view
+cannot see.  A cycle means two threads can deadlock
+batcher↔manager↔registry; any cycle is a finding.
+
+This module also exports the shared lock model (`build_lock_model`,
+`infer_guards`) the concurrency-tier rules
+(`nerrf_tpu/analysis/concurrency.py`) are built on: the same per-method
+walk records every access, call and acquisition with the lexically-held
+lock set AND a lock-region id (each ``with <lock>:`` body is one atomic
+region), so atomicity/callback/blocking analyses agree with this rule
+about what is guarded and where.
 """
 
 from __future__ import annotations
@@ -38,8 +51,9 @@ from typing import Dict, FrozenSet, List, Optional, Set, Tuple
 from nerrf_tpu.analysis.astutil import ModuleInfo, dotted
 from nerrf_tpu.analysis.engine import Finding, Rule
 
-DEFAULT_SCOPE = ("nerrf_tpu/serve/", "nerrf_tpu/registry/",
-                 "nerrf_tpu/observability.py")
+# PR 5 scoped this to serve/+registry/+observability.py; the concurrency
+# tier widened it to the whole package (None = no path filter)
+DEFAULT_SCOPE = None
 
 _LOCK_TYPES = frozenset({"Lock", "RLock", "Condition"})
 _MUTATORS = frozenset({
@@ -55,6 +69,26 @@ class _Access:
     line: int
     method: str
     held: FrozenSet[str]
+    # lock-region id: 0 outside any lock, a fresh positive id per lexical
+    # ``with <lock>:`` body — two accesses in the same region are atomic
+    # with respect to that lock, accesses in different regions are not
+    region: int = 0
+
+
+@dataclasses.dataclass
+class _Call:
+    """One call site inside a method, with its lock state.  ``callee`` is
+    the plain name for ``self.x()`` / bare ``x()`` and ``*.x`` for a
+    foreign ``obj.x()``; ``node`` is the raw ast.Call for rules that need
+    to look at the receiver/arguments (callback/blocking analysis)."""
+
+    method: str
+    callee: str
+    held: FrozenSet[str]
+    line: int
+    region: int
+    node: ast.Call
+    bare: bool = False   # bare-name call f(...) — never an implicit self
 
 
 @dataclasses.dataclass
@@ -64,9 +98,7 @@ class _ClassInfo:
     locks: Set[str] = dataclasses.field(default_factory=set)
     methods: Dict[str, ast.AST] = dataclasses.field(default_factory=dict)
     accesses: List[_Access] = dataclasses.field(default_factory=list)
-    # method → [(callee-or-None for foreign, name, held-at-site)]
-    calls: List[Tuple[str, str, FrozenSet[str]]] = \
-        dataclasses.field(default_factory=list)
+    calls: List[_Call] = dataclasses.field(default_factory=list)
     # acquisitions observed: (method, acquired-name, held-at-site, line)
     acquisitions: List[Tuple[str, str, FrozenSet[str], int]] = \
         dataclasses.field(default_factory=list)
@@ -126,28 +158,32 @@ def _collect_classes(mod: ModuleInfo) -> List[_ClassInfo]:
 def _walk_method(ci: _ClassInfo, name: str, node: ast.AST,
                  lock_attr_names: Set[str]) -> None:
     """Record accesses, intra/foreign calls and acquisitions with the
-    lexically-held lock set."""
+    lexically-held lock set and the lock-region id."""
+    next_region = [0]
 
-    def rec_target(t: ast.AST, held, kind: str) -> None:
+    def rec_target(t: ast.AST, held, region, kind: str) -> None:
         attr = _self_attr(t)
         if attr and attr not in ci.locks:
-            ci.accesses.append(_Access(attr, kind, t.lineno, name, held))
+            ci.accesses.append(_Access(attr, kind, t.lineno, name, held,
+                                       region))
         elif isinstance(t, ast.Subscript):
             attr = _self_attr(t.value)
             if attr and attr not in ci.locks:
                 ci.accesses.append(
-                    _Access(attr, "mutate", t.lineno, name, held))
+                    _Access(attr, "mutate", t.lineno, name, held, region))
         elif isinstance(t, (ast.Tuple, ast.List)):
             for el in t.elts:
-                rec_target(el, held, kind)
+                rec_target(el, held, region, kind)
 
-    def walk(n: ast.AST, held: FrozenSet[str]) -> None:
+    def walk(n: ast.AST, held: FrozenSet[str], region: int) -> None:
         if isinstance(n, ast.With):
             inner = set(held)
+            acquired = False
             for item in n.items:
                 attr = _self_attr(item.context_expr)
                 if attr and attr in ci.locks:
                     inner.add(attr)
+                    acquired = True
                     ci.acquisitions.append(
                         (name, attr, held, item.context_expr.lineno))
                 elif isinstance(item.context_expr, ast.Attribute) and \
@@ -158,11 +194,16 @@ def _walk_method(ci: _ClassInfo, name: str, node: ast.AST,
                         (name, item.context_expr.attr, held,
                          item.context_expr.lineno))
                     inner.add(f"~{item.context_expr.attr}")
+                    acquired = True
                 if item.optional_vars is not None:
-                    walk(item.optional_vars, frozenset(inner))
-                walk(item.context_expr, held)
+                    walk(item.optional_vars, frozenset(inner), region)
+                walk(item.context_expr, held, region)
+            body_region = region
+            if acquired:   # each lock body is its own atomic region
+                next_region[0] += 1
+                body_region = next_region[0]
             for stmt in n.body:
-                walk(stmt, frozenset(inner))
+                walk(stmt, frozenset(inner), body_region)
             return
         if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
                           ast.Lambda)):
@@ -171,61 +212,135 @@ def _walk_method(ci: _ClassInfo, name: str, node: ast.AST,
             targets = n.targets if isinstance(n, ast.Assign) else [n.target]
             kind = "mutate" if isinstance(n, ast.AugAssign) else "rebind"
             for t in targets:
-                rec_target(t, held, kind)
+                rec_target(t, held, region, kind)
             if n.value is not None:
-                walk(n.value, held)
+                walk(n.value, held, region)
             return
         if isinstance(n, ast.Delete):
             for t in n.targets:
-                rec_target(t, held, "mutate")
+                rec_target(t, held, region, "mutate")
             return
         if isinstance(n, ast.Call):
             d = dotted(n.func)
             if d is not None:
                 parts = d.split(".")
                 if parts[0] == "self" and len(parts) == 2:
-                    ci.calls.append((name, parts[1], held))
+                    ci.calls.append(_Call(name, parts[1], held, n.lineno,
+                                          region, n))
                 elif len(parts) >= 2:
-                    ci.calls.append((name, f"*.{parts[-1]}", held))
+                    ci.calls.append(_Call(name, f"*.{parts[-1]}", held,
+                                          n.lineno, region, n))
+                else:
+                    ci.calls.append(_Call(name, parts[0], held, n.lineno,
+                                          region, n, bare=True))
                 if len(parts) >= 2 and parts[-1] in _MUTATORS:
                     attr = _self_attr(n.func.value)
                     if attr and attr not in ci.locks:
                         ci.accesses.append(_Access(
-                            attr, "mutate", n.lineno, name, held))
+                            attr, "mutate", n.lineno, name, held, region))
         if isinstance(n, ast.Attribute) and isinstance(n.ctx, ast.Load):
             attr = _self_attr(n)
             if attr and attr not in ci.locks:
                 ci.accesses.append(_Access(attr, "read", n.lineno,
-                                           name, held))
+                                           name, held, region))
         for child in ast.iter_child_nodes(n):
-            walk(child, held)
+            walk(child, held, region)
 
     for stmt in node.body:
-        walk(stmt, frozenset())
+        walk(stmt, frozenset(), 0)
+
+
+def in_scope(mod: ModuleInfo, scope: Optional[Tuple[str, ...]]) -> bool:
+    """Path filter shared by every lock-model rule (None = everything)."""
+    if scope is None:
+        return True
+    return any(mod.path.startswith(s) or mod.path == s.rstrip("/")
+               for s in scope)
+
+
+def _propagate_entry(ci: _ClassInfo) -> None:
+    """Held-lock state propagated into private methods whose intra-class
+    call sites all hold the lock (fixpoint)."""
+    ci.entry = {m: frozenset() for m in ci.methods}
+    sites: Dict[str, List[Tuple[str, FrozenSet[str]]]] = {}
+    for c in ci.calls:
+        if not c.bare and c.callee in ci.methods:
+            sites.setdefault(c.callee, []).append((c.method, c.held))
+    for _ in range(4):  # fixpoint over short call chains
+        changed = False
+        for m in ci.methods:
+            if not m.startswith("_") or m.startswith("__") \
+                    or m not in sites:
+                continue  # public or uncalled: assume callable bare
+            new = None
+            for caller, held in sites[m]:
+                eff = held | ci.entry.get(caller, frozenset())
+                new = eff if new is None else (new & eff)
+            new = new or frozenset()
+            if new != ci.entry[m]:
+                ci.entry[m] = new
+                changed = True
+        if not changed:
+            break
+
+
+def build_lock_model(project, scope: Optional[Tuple[str, ...]] = None
+                     ) -> List[_ClassInfo]:
+    """The shared concurrency model: every class in scope with its locks,
+    accesses, calls, acquisitions and entry-held sets resolved.  Cached on
+    the project — lock-discipline and the concurrency-tier rules analyze
+    one identical model."""
+    cached = getattr(project, "_lock_model", None)
+    if cached is not None and cached[0] == scope:
+        return cached[1]
+    classes: List[_ClassInfo] = []
+    for mod in project.modules.values():
+        if in_scope(mod, scope):
+            classes.extend(_collect_classes(mod))
+    lock_attr_names = {lk for ci in classes for lk in ci.locks}
+    for ci in classes:
+        for mname, mnode in ci.methods.items():
+            _walk_method(ci, mname, mnode, lock_attr_names)
+        if ci.locks:
+            _propagate_entry(ci)
+    project._lock_model = (scope, classes)
+    return classes
+
+
+def infer_guards(ci: _ClassInfo) -> Tuple[Dict[str, Set[str]], Set[str]]:
+    """→ (attr → guard-lock set, container attrs).  An attribute is
+    guarded when it is written/mutated at least once outside ``__init__``
+    while one of the class's locks is held; containers are attrs mutated
+    in place anywhere (their bare reads observe torn state)."""
+    guards: Dict[str, Set[str]] = {}
+    containers: Set[str] = set()
+    for a in ci.accesses:
+        held = a.held | ci.entry.get(a.method, frozenset())
+        if a.kind in ("mutate", "rebind"):
+            if a.kind == "mutate":
+                containers.add(a.attr)
+            if a.method != "__init__" and held:
+                guards.setdefault(a.attr, set()).update(
+                    h for h in held if not h.startswith("~"))
+    return guards, containers
 
 
 class LockDiscipline(Rule):
     id = "lock-discipline"
     description = ("lock-guarded attribute access outside `with self.lock` "
-                   "+ lock-acquisition-order cycles (serve/registry/"
-                   "observability)")
+                   "+ cross-class lock-acquisition-order cycles "
+                   "(whole package)")
 
     def __init__(self, scope: Optional[Tuple[str, ...]] = DEFAULT_SCOPE
                  ) -> None:
         self.scope = scope
-
-    def _in_scope(self, mod: ModuleInfo) -> bool:
-        if self.scope is None:
-            return True
-        return any(mod.path.startswith(s) or mod.path == s.rstrip("/")
-                   for s in self.scope)
 
     def inventory(self, project) -> Dict[str, List[str]]:
         """Class → lock attrs, for docs/tests ('the module-level lock
         inventory')."""
         out: Dict[str, List[str]] = {}
         for mod in project.modules.values():
-            if not self._in_scope(mod):
+            if not in_scope(mod, self.scope):
                 continue
             for ci in _collect_classes(mod):
                 if ci.locks:
@@ -233,60 +348,18 @@ class LockDiscipline(Rule):
         return out
 
     def run(self, project) -> List[Finding]:
-        classes: List[_ClassInfo] = []
-        for mod in project.modules.values():
-            if self._in_scope(mod):
-                classes.extend(_collect_classes(mod))
-        lock_attr_names = {lk for ci in classes for lk in ci.locks}
-        for ci in classes:
-            for mname, mnode in ci.methods.items():
-                _walk_method(ci, mname, mnode, lock_attr_names)
+        classes = build_lock_model(project, self.scope)
         findings = []
         for ci in classes:
             if ci.locks:
-                self._propagate_entry(ci)
                 findings.extend(self._discipline(ci))
         findings.extend(self._order_cycles(classes))
         return findings
 
-    # -- entry-held propagation ----------------------------------------------
-
-    def _propagate_entry(self, ci: _ClassInfo) -> None:
-        ci.entry = {m: frozenset() for m in ci.methods}
-        sites: Dict[str, List[Tuple[str, FrozenSet[str]]]] = {}
-        for caller, callee, held in ci.calls:
-            if callee in ci.methods:
-                sites.setdefault(callee, []).append((caller, held))
-        for _ in range(4):  # fixpoint over short call chains
-            changed = False
-            for m in ci.methods:
-                if not m.startswith("_") or m.startswith("__") \
-                        or m not in sites:
-                    continue  # public or uncalled: assume callable bare
-                new = None
-                for caller, held in sites[m]:
-                    eff = held | ci.entry.get(caller, frozenset())
-                    new = eff if new is None else (new & eff)
-                new = new or frozenset()
-                if new != ci.entry[m]:
-                    ci.entry[m] = new
-                    changed = True
-            if not changed:
-                break
-
     # -- per-class discipline -------------------------------------------------
 
     def _discipline(self, ci: _ClassInfo) -> List[Finding]:
-        guards: Dict[str, Set[str]] = {}
-        containers: Set[str] = set()
-        for a in ci.accesses:
-            held = a.held | ci.entry.get(a.method, frozenset())
-            if a.kind in ("mutate", "rebind"):
-                if a.kind == "mutate":
-                    containers.add(a.attr)
-                if a.method != "__init__" and held:
-                    guards.setdefault(a.attr, set()).update(
-                        h for h in held if not h.startswith("~"))
+        guards, containers = infer_guards(ci)
         out: List[Finding] = []
         seen = set()
         for a in ci.accesses:
@@ -328,12 +401,30 @@ class LockDiscipline(Rule):
                 acquires[(ci.name, m)] = {
                     f"{ci.name}.{a}" for mm, a, _h, _l in ci.acquisitions
                     if mm == m and a in ci.locks}
-        for _ in range(4):  # transitive closure over intra-class calls
+        # transitive closure over intra-class calls AND uniquely-named
+        # cross-class calls from the project index: the batcher → journal
+        # → recorder chain is a cross-module edge the per-class sets
+        # cannot carry
+        for _ in range(6):
+            changed = False
             for ci in classes:
-                for caller, callee, _held in ci.calls:
-                    if callee in ci.methods:
-                        acquires[(ci.name, caller)] |= \
-                            acquires[(ci.name, callee)]
+                for c in ci.calls:
+                    if not c.bare and c.callee in ci.methods:
+                        extra = acquires[(ci.name, c.callee)]
+                    elif c.callee.startswith("*."):
+                        owners = method_owner.get(c.callee[2:], [])
+                        if len(owners) != 1:
+                            continue  # ambiguous foreign method: no edge
+                        oci, om = owners[0]
+                        extra = acquires.get((oci.name, om), set())
+                    else:
+                        continue
+                    cur = acquires[(ci.name, c.method)]
+                    if extra - cur:
+                        cur |= extra
+                        changed = True
+            if not changed:
+                break
 
         def qual(ci: _ClassInfo, held_name: str) -> Optional[str]:
             if held_name.startswith("~"):
@@ -359,15 +450,16 @@ class LockDiscipline(Rule):
                     src = qual(ci, h)
                     if src:
                         add_edge(src, tgt, f"{ci.mod.path}:{line}")
-            for m, callee, held in ci.calls:
-                eff = held | ci.entry.get(m, frozenset())
-                if not eff:
+            for c in ci.calls:
+                eff = c.held | ci.entry.get(c.method, frozenset())
+                if not eff or c.bare:
                     continue
-                key = callee[2:] if callee.startswith("*.") else callee
+                key = c.callee[2:] if c.callee.startswith("*.") \
+                    else c.callee
                 owners = method_owner.get(key, [])
-                if callee.startswith("*.") and len(owners) != 1:
+                if c.callee.startswith("*.") and len(owners) != 1:
                     continue  # ambiguous foreign method: no edge
-                for oci, om in (owners if callee.startswith("*.")
+                for oci, om in (owners if c.callee.startswith("*.")
                                 else [(ci, key)] if key in ci.methods
                                 else []):
                     for tgt in acquires.get((oci.name, om), ()):  # noqa: B007
@@ -375,7 +467,8 @@ class LockDiscipline(Rule):
                             src = qual(ci, h)
                             if src:
                                 add_edge(src, tgt,
-                                         f"{ci.mod.path}:{ci.name}.{m}")
+                                         f"{ci.mod.path}:{ci.name}."
+                                         f"{c.method}")
 
         return self._find_cycles(edges, edge_site)
 

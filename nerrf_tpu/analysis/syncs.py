@@ -1,7 +1,7 @@
 """sync-in-hot-loop: device syncs inside host loops must be deliberate.
 
 `block_until_ready`, `jax.device_get`, `.item()` and the repo's own
-`sync_result` each fence the dispatch queue: inside a `for`/`while` loop
+`sync_result`/`fetch_value` each fence the dispatch queue: inside a `for`/`while` loop
 they serialize host and device per iteration, which is exactly the
 idle-accelerator failure mode the tracing spine exists to expose
 (train_host_blocked_fraction).  A sync in a loop is sometimes the point —
@@ -31,7 +31,7 @@ DEFAULT_ALLOW = frozenset({
     "OnlineDetectionService._score_fn",
 })
 
-_SYNC_LAST = frozenset({"block_until_ready", "sync_result"})
+_SYNC_LAST = frozenset({"block_until_ready", "sync_result", "fetch_value"})
 
 
 def _sync_call(call: ast.Call) -> str:

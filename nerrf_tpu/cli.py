@@ -656,11 +656,11 @@ def _profile_costs(args) -> int:
             program = f"serve_eval[{tag}]"
             if program not in rows:
                 continue
+            # nerrflint: ok[sync-in-hot-loop] per-bucket compile barrier before the timed measurement loop
             fetch_value(eval_fn(params, batch)["node_logit"])  # compile
             t0 = time.perf_counter()
             for _ in range(args.measure):
-                # nerrflint: ok[sync-in-hot-loop] the sync IS the
-                # measurement (device seconds per call)
+                # nerrflint: ok[sync-in-hot-loop] the sync IS the measurement (device seconds per call)
                 fetch_value(eval_fn(params, batch)["node_logit"])
             per_call = (time.perf_counter() - t0) / args.measure
             flops = rows[program]["flops"]
@@ -755,6 +755,7 @@ def _profile_capture(args) -> int:
         _log("no warmup donor batches for the configured ladder")
         return 2
     for _tag, batch in donors:  # compile OUTSIDE the capture window
+        # nerrflint: ok[sync-in-hot-loop] per-bucket compile barrier so the capture shows steady-state scoring, not compiles
         fetch_value(eval_fn(params, batch)["node_logit"])
     deadline = time.monotonic() + args.seconds
     with profiled(args.out) as active:
@@ -799,7 +800,9 @@ def cmd_trace(args) -> int:
 # --------------------------------------------------------------------------
 def cmd_lint(args) -> int:
     """Static analysis over the package's own ASTs (nerrflint): jax-purity,
-    recompile-hazard, sync-in-hot-loop, lock-discipline, metrics-contract.
+    recompile-hazard, sync-in-hot-loop, lock-discipline, the concurrency
+    tier (atomicity-violation, callback-under-lock, blocking-under-lock,
+    thread-lifecycle), metrics-contract.
     Same engine as scripts/nerrflint.py and the tier-1 gate
     (tests/test_analysis.py); rule catalog in docs/static-analysis.md.
     Deliberately NO jax import — safe on any host, including one with a
@@ -1700,8 +1703,9 @@ def main(argv=None) -> int:
 
     p = sub.add_parser("lint", help="static analysis over nerrf_tpu's own "
                                     "ASTs (purity, recompile, sync, lock "
-                                    "discipline, metrics contract); --deep "
-                                    "adds the jaxpr-level program contracts")
+                                    "discipline, the concurrency tier, "
+                                    "metrics contract); --deep adds the "
+                                    "jaxpr-level program contracts")
     p.add_argument("--json", action="store_true",
                    help="machine-readable report")
     p.add_argument("--list-rules", action="store_true",

@@ -286,6 +286,7 @@ class FlightRecorder:
                 # (unless a concurrent fire already succeeded after us)
                 if self._last_fire.get(name) == now:
                     if last is None:
+                        # nerrflint: ok[atomicity-violation] split on purpose: this rollback re-validates under the lock (stamp still ours, the .get above) before undoing, so a concurrent successful fire is never clobbered
                         self._last_fire.pop(name, None)
                     else:
                         self._last_fire[name] = last
@@ -307,6 +308,7 @@ class FlightRecorder:
         # rate-limit stamp, no .tmp orphan) is what survives
         chaos.inject("flight.disk_full", trigger=trigger)
         out_root = os.fspath(self.cfg.out_dir)
+        # nerrflint: ok[blocking-under-lock] serializing bundle IO is the dump lock's entire purpose: concurrent triggers and the retention sweep must never interleave half-written dirs; only other dumps wait here
         os.makedirs(out_root, exist_ok=True)
         stamp = time.strftime("%Y%m%dT%H%M%S", time.gmtime())
         with self._lock:
@@ -400,6 +402,7 @@ class FlightRecorder:
         return final
 
     def _enforce_retention(self, out_root: str) -> None:
+        # nerrflint: ok[blocking-under-lock] retention runs under the dump lock BY DESIGN — deleting bundle dirs must never race a concurrent dump's os.replace; only other dumps wait
         entries = [e for e in os.listdir(out_root) if e.startswith("bundle-")]
         # sweep stale .tmp dirs from a crash mid-dump in an EARLIER process
         # (a failed dump in this one already cleaned up after itself)

@@ -241,6 +241,7 @@ class ModelManager:
                     # volume error) must not wedge the poll loop with the
                     # shadow double-scoring forever on a promotion that
                     # can never land: veto locally and surface the error
+                    # nerrflint: ok[callback-under-lock] _log is a one-line CLI/print logger by contract; only the poll thread and CLI pokes take _poll_lock — the scorer thread never does
                     self._log(f"registry: auto-promotion of "
                               f"v{self._shadow_version} cannot write the "
                               f"registry ({e}); unstaging the candidate — "
@@ -317,6 +318,7 @@ class ModelManager:
                 # the compiled programs — veto so the poll loop does not
                 # reload + re-stage it to device every poll_sec forever
                 self._vetoed.add(version)
+                # nerrflint: ok[callback-under-lock] same one-line-logger contract as _poll_locked; swap cadence tolerates a log line
                 self._log(f"registry: cannot swap to v{version}: {e}")
                 out.update(action="error", error=f"swap v{version}: {e}")
                 return out
@@ -370,6 +372,7 @@ class ModelManager:
             except ValueError as e:
                 # same pytree gate as the swap path: veto, don't retry
                 self._vetoed.add(version)
+                # nerrflint: ok[callback-under-lock] same one-line-logger contract as _poll_locked; shadow staging cadence tolerates a log line
                 self._log(f"registry: cannot stage shadow v{version}: {e}")
                 out.update(action="error", error=f"shadow v{version}: {e}")
                 return out

@@ -300,6 +300,7 @@ class MicroBatcher:
                 "serve_recompiles_total", labels={"bucket": tag},
                 help="device batches scored at a bucket shape not compiled "
                      "during warmup (steady state must stay at 0)")
+            # nerrflint: ok[atomicity-violation] benign split: set.add is idempotent and only the single scorer thread reaches here — worst case a racing drain_once double-counts one recompile
             self.mark_warm(bucket)
         failures: List[Tuple[List[WindowRequest], BaseException]] = []
         scored_n = self._score_cohort(bucket, tag, reqs, 0, failures)

@@ -398,6 +398,7 @@ class OnlineDetectionService:
         jax.block_until_ready(staged)  # transfer cost lands OUTSIDE the lock
         want_thr = threshold if threshold is not None else self._boot_threshold
         with self._swap_lock:
+            # nerrflint: ok[atomicity-violation] benign split: the compatibility check above validates the pytree SIGNATURE, which is invariant across swaps (the compiled-programs contract) — a concurrent swap cannot change what was validated
             self._params = staged
             self._live_version = version
             if want_thr != self.cfg.threshold:
@@ -514,13 +515,17 @@ class OnlineDetectionService:
             # cost-model registration OFF the boot path: analytic FLOPs
             # per bucket program (shape-level make_jaxpr, no compile, no
             # device work — zero-recompile contract untouched) resolve on
-            # a daemon thread so readiness never waits on them.  Until a
-            # program's cost lands its MFU gauge is simply absent — the
+            # a background thread so readiness never waits on them.  Until
+            # a program's cost lands its MFU gauge is simply absent — the
             # seconds/util gauges flow from the first scored batch either
-            # way
+            # way.  NON-daemon on purpose (thread-lifecycle lint): a
+            # daemon thread still inside jax tracing at interpreter
+            # teardown segfaults the process; the stop flag + bounded
+            # join in stop() (and the finite bucket sweep) bound its life
+            # instead
             self._devtime_stop.clear()
             self._devtime_thread = threading.Thread(
-                target=self._register_devtime_costs, daemon=True,
+                target=self._register_devtime_costs, daemon=False,
                 name="nerrf-devtime-costs")
             self._devtime_thread.start()
         self._batcher.start()
@@ -560,11 +565,11 @@ class OnlineDetectionService:
         self._admission_open = False
         self._batcher.stop(drain=drain)
         if self._devtime_thread is not None:
-            # wait the cost thread out (bounded): a daemon thread still
-            # inside jax tracing when the interpreter tears down after a
-            # fast boot-and-exit (cache warm CLI) segfaults the process.
-            # The stop flag skips remaining buckets; the in-progress
-            # trace is O(seconds)
+            # wait the cost thread out (bounded): it is non-daemon
+            # precisely so a fast boot-and-exit (cache warm CLI) can
+            # never tear the interpreter down under an in-progress jax
+            # trace — the historical segfault class.  The stop flag skips
+            # remaining buckets; the in-progress trace is O(seconds)
             self._devtime_stop.set()
             self._devtime_thread.join(timeout=30.0)
             self._devtime_thread = None
@@ -916,6 +921,48 @@ class OnlineDetectionService:
                 e2e_sec=e2e)
             if self._flight is not None:
                 self._flight.observe_window(s.stream, s.trace_id, e2e)
+            # alerting: hot windows only, never blocking (bounded sink).
+            # Fail-open per window: a raising sink/quality observer must
+            # lose at most this window's alert, never the ledger
+            # resolution below — an unresolved window wedges leave()
+            try:
+                mask = s.node_mask.astype(bool)
+                hot_slots = (np.nonzero(mask & (s.probs >= alert_thr))[0]
+                             if mask.any() else np.empty(0, np.int64))
+                if self._quality is not None:
+                    # drift sketches at the demux boundary (base stream
+                    # name: a resident stream's reconnect sessions are
+                    # the same traffic population, not fresh label
+                    # series)
+                    self._quality.observe_window(
+                        _base_stream(s.stream), bucket_tag(s.bucket),
+                        s.probs, mask, s.node_type,
+                        nodes=s.nodes, edges=s.edges, files=s.files,
+                        alerted=bool(len(hot_slots)))
+                if len(hot_slots):
+                    order = np.argsort(-s.probs[hot_slots], kind="stable")
+                    hot = [("file" if s.node_type[i] == NODE_TYPE_FILE
+                            else "proc",
+                            int(s.node_key[i]), float(s.probs[i]))
+                           for i in hot_slots[order][:16]]
+                    self.sink.emit(WindowAlert(
+                        stream=s.stream, window_idx=s.window_idx,
+                        lo_ns=s.lo_ns, hi_ns=s.hi_ns,
+                        max_prob=float(s.probs[mask].max()), hot=hot,
+                        t_admit=s.t_admit, t_scored=s.t_scored,
+                        late=s.late, model_version=s.model_version,
+                        trace_id=s.trace_id))
+            except Exception as e:  # noqa: BLE001 — demux must resolve
+                self._journal.record(
+                    "demux_drop", stream=s.stream, window_id=s.window_idx,
+                    trace_id=s.trace_id, reason="emit_error",
+                    error=f"{type(e).__name__}: {e}")
+            # ledger resolution LAST: the cond notify releases leave()
+            # waiters, so every demux side-effect (alert emission, drift
+            # sketch) must be complete BEFORE it fires — notifying first
+            # let a leave() return (and its caller read counters/drain
+            # alerts) while this window's alert was still unemitted,
+            # a check-then-act race the concurrency lint tier exists for
             with self._lock:
                 handle = self._streams.get(s.stream)
             if handle is not None:
@@ -923,31 +970,6 @@ class OnlineDetectionService:
                     handle.live.pop(s.window_idx, None)
                     handle.scored.append(s)
                     handle.cond.notify_all()
-            # alerting: hot windows only, never blocking (bounded sink)
-            mask = s.node_mask.astype(bool)
-            hot_slots = (np.nonzero(mask & (s.probs >= alert_thr))[0]
-                         if mask.any() else np.empty(0, np.int64))
-            if self._quality is not None:
-                # drift sketches at the demux boundary (base stream name:
-                # a resident stream's reconnect sessions are the same
-                # traffic population, not fresh label series)
-                self._quality.observe_window(
-                    _base_stream(s.stream), bucket_tag(s.bucket),
-                    s.probs, mask, s.node_type,
-                    nodes=s.nodes, edges=s.edges, files=s.files,
-                    alerted=bool(len(hot_slots)))
-            if not len(hot_slots):
-                continue
-            order = np.argsort(-s.probs[hot_slots], kind="stable")
-            hot = [("file" if s.node_type[i] == NODE_TYPE_FILE else "proc",
-                    int(s.node_key[i]), float(s.probs[i]))
-                   for i in hot_slots[order][:16]]
-            self.sink.emit(WindowAlert(
-                stream=s.stream, window_idx=s.window_idx,
-                lo_ns=s.lo_ns, hi_ns=s.hi_ns,
-                max_prob=float(s.probs[mask].max()), hot=hot,
-                t_admit=s.t_admit, t_scored=s.t_scored, late=s.late,
-                model_version=s.model_version, trace_id=s.trace_id))
 
     def _on_failed(self, reqs: List[WindowRequest], exc: BaseException) -> None:
         """Terminal failure for a cohort the batcher could not score.

@@ -798,7 +798,11 @@ def train_sharded_stream(
         except BaseException as e:  # propagate instead of hanging the train
             host_q.put(e)
 
-    thread = threading.Thread(target=reader, daemon=True)
+    # named so journal records and faulthandler dumps attribute shard-read
+    # stalls to this subsystem; daemon is safe here — the reader touches
+    # only numpy/disk (never jax), and the finally below joins it anyway
+    thread = threading.Thread(target=reader, daemon=True,
+                              name="nerrf-train-reader")
     thread.start()
 
     def next_host_shard():

@@ -20,13 +20,21 @@ from pathlib import Path
 
 from nerrf_tpu.analysis import analyze
 from nerrf_tpu.analysis.astutil import Project, collect_files
+from nerrf_tpu.analysis.concurrency import (
+    AtomicityViolation,
+    BlockingUnderLock,
+    CallbackUnderLock,
+    ThreadLifecycle,
+)
 from nerrf_tpu.analysis.locks import LockDiscipline
 from nerrf_tpu.analysis.purity import JaxPurity
 from nerrf_tpu.analysis.recompile import RecompileHazard
 from nerrf_tpu.analysis.syncs import SyncInHotLoop
 
 RULE_IDS = {"jax-purity", "recompile-hazard", "sync-in-hot-loop",
-            "lock-discipline", "metrics-contract"}
+            "lock-discipline", "metrics-contract",
+            "atomicity-violation", "callback-under-lock",
+            "blocking-under-lock", "thread-lifecycle"}
 
 
 def _fixture(tmp_path: Path, files: dict) -> Path:
@@ -439,6 +447,355 @@ def test_lock_inventory_covers_the_threaded_planes(repo_root):
         inv["nerrf_tpu/serve/service.py:OnlineDetectionService"]
     assert "_lock" in inv["nerrf_tpu/observability.py:MetricsRegistry"]
     assert "_lock" in inv["nerrf_tpu/registry/guardrails.py:ShadowStats"]
+
+
+# -- the concurrency tier -----------------------------------------------------
+
+
+_SPLIT_SRC = {"pkg/split.py": """\
+    import threading
+
+    class Counter:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._n = 0
+            self._cache = None
+
+        def bump(self):
+            with self._lock:
+                self._n += 1
+
+        def maybe_reset(self):
+            if self._cache:                  # check OUTSIDE the lock
+                with self._lock:
+                    self._cache = None       # act under the lock
+
+        def split_rmw(self):
+            with self._lock:
+                n = self._n
+            with self._lock:
+                self._n = n + 1
+
+        def check_then_call(self):
+            with self._lock:
+                n = self._n
+            if n == 0:
+                self.bump()
+
+        def atomic_reset(self):
+            with self._lock:
+                if self._cache:
+                    self._cache = None
+
+        def _reset_locked(self):
+            if self._cache:
+                self._cache = None
+
+        def entry_held(self):
+            with self._lock:
+                self._reset_locked()
+    """}
+
+
+def test_atomicity_flags_split_regions_not_atomic_ones(tmp_path):
+    found = _run(tmp_path, _SPLIT_SRC, [AtomicityViolation()])
+    anchors = {f.anchor for f in found}
+    # check outside the lock, act inside: the canonical split
+    assert "Counter.maybe_reset:_cache:split" in anchors
+    # read-modify-write across two separately-locked regions
+    assert "Counter.split_rmw:_n:split" in anchors
+    # read under the lock, act through a self-call that RE-locks
+    assert "Counter.check_then_call:_n:split" in anchors
+    # one region / entry-held callee: atomic by construction, quiet
+    assert not any(a.startswith("Counter.atomic_reset") for a in anchors)
+    assert not any(a.startswith("Counter._reset_locked") for a in anchors)
+    assert not any(a.startswith("Counter.entry_held") for a in anchors)
+    assert len(found) == 3
+
+
+def test_atomicity_quiet_when_callee_runs_in_callers_region(tmp_path):
+    """A locked helper invoked WHILE the guard is held is the same atomic
+    region (the headroom observe/evict shape), not a split."""
+    found = _run(tmp_path, {"pkg/track.py": """\
+        import threading
+
+        class Tracker:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._events = []
+
+            def observe(self, t):
+                with self._lock:
+                    self._events.append(t)
+                    self._evict(t)
+
+            def _evict(self, now):
+                while self._events and self._events[0] < now - 60:
+                    self._events.pop(0)
+        """}, [AtomicityViolation()])
+    assert found == []
+
+
+_CB_SRC = {"pkg/bus.py": """\
+    import threading
+
+    class Bus:
+        def __init__(self, on_drop=None):
+            self._lock = threading.Lock()
+            self._listeners = []
+            self._items = []
+            self._on_drop = on_drop or (lambda item: None)
+
+        def subscribe(self, fn):
+            with self._lock:
+                self._listeners.append(fn)
+
+        def bad_publish(self, item):
+            with self._lock:
+                self._items.append(item)
+                for fn in self._listeners:
+                    fn(item)
+
+        def bad_drop(self, item):
+            with self._lock:
+                self._on_drop(item)
+
+        def good_publish(self, item):
+            with self._lock:
+                self._items.append(item)
+                listeners = list(self._listeners)
+            for fn in listeners:
+                fn(item)
+    """}
+
+
+def test_callback_under_lock_flags_fanout_and_injected_fn(tmp_path):
+    found = _run(tmp_path, _CB_SRC, [CallbackUnderLock()])
+    anchors = {f.anchor for f in found}
+    # listener fan-out inside the lock: the journal contract, violated
+    assert "Bus.bad_publish:fn:callback" in anchors
+    # injected callback attr (assigned from a parameter) called under lock
+    assert "Bus.bad_drop:_on_drop:callback" in anchors
+    # snapshot-then-fan-out-outside (EventJournal.record pattern): quiet
+    assert not any(a.startswith("Bus.good_publish") for a in anchors)
+    assert len(found) == 2
+
+
+def test_blocking_under_lock_cross_module_and_quiet_outside(tmp_path):
+    found = _run(tmp_path, {
+        "pkg/helper.py": """\
+            import time
+
+            def backoff():
+                time.sleep(0.1)
+            """,
+        "pkg/srv.py": """\
+            import threading
+
+            from pkg.helper import backoff
+
+            class Srv:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._state = {}
+
+                def bad(self):
+                    with self._lock:
+                        self._state["x"] = 1
+                        backoff()
+
+                def bad_io(self, path):
+                    with self._lock:
+                        open(path).read()
+
+                def good(self):
+                    with self._lock:
+                        snap = dict(self._state)
+                    backoff()
+                    return snap
+            """}, [BlockingUnderLock()])
+    anchors = {f.anchor for f in found}
+    assert "Srv.bad:_lock:blocking" in anchors
+    assert "Srv.bad_io:_lock:blocking" in anchors
+    assert not any(a.startswith("Srv.good") for a in anchors)
+    bad = next(f for f in found if f.anchor == "Srv.bad:_lock:blocking")
+    # the cross-module walk names the effect AND the path to it
+    assert "time.sleep" in bad.message and "backoff" in bad.message
+
+
+_THREAD_SRC = {
+    "pkg/heavy.py": """\
+        import jax
+
+        def crunch():
+            return jax.jit(lambda x: x)(1)
+        """,
+    "pkg/workers.py": """\
+        import threading
+
+        import pkg.heavy as heavy
+
+        class Svc:
+            def start(self):
+                self._t = threading.Thread(target=self._run, daemon=True,
+                                           name="nerrf-w")
+                self._t.start()
+
+            def _run(self):
+                heavy.crunch()
+
+            def stop(self):
+                self._t.join(timeout=1.0)
+
+        class Leaky:
+            def start(self):
+                self._t = threading.Thread(target=print, name="nerrf-leak")
+                self._t.start()
+
+            def stop(self):
+                pass
+
+        def spawn_unnamed():
+            t = threading.Thread(target=print)
+            t.start()
+            return t
+        """}
+
+
+def test_thread_lifecycle_daemon_jax_unnamed_and_unjoined(tmp_path):
+    found = _run(tmp_path, _THREAD_SRC, [ThreadLifecycle()])
+    anchors = {f.anchor for f in found}
+    # jax-reachable work (through the import chain) on a daemon thread:
+    # the interpreter-teardown segfault class
+    assert "Svc.start:thread:daemon-jax" in anchors
+    # unnamed thread: journal/watchdog/faulthandler attribution is lost
+    assert "spawn_unnamed:thread:unnamed" in anchors
+    # self-held thread no method of the class ever joins
+    assert "Leaky:_t:unjoined" in anchors
+    # named + joined (Svc) produces neither unnamed nor unjoined
+    assert not any(a.endswith(":unnamed") and a.startswith("Svc")
+                   for a in anchors)
+    assert "Svc:_t:unjoined" not in anchors
+    assert len(found) == 3
+
+
+def test_thread_lifecycle_quiet_on_nondaemon_jax(tmp_path):
+    """The fixed devtime shape: jax work on a NON-daemon, named, joined
+    thread is the sanctioned pattern."""
+    src = dict(_THREAD_SRC)
+    src["pkg/workers.py"] = src["pkg/workers.py"].replace(
+        "daemon=True,", "daemon=False,")
+    found = _run(tmp_path, src, [ThreadLifecycle()])
+    assert not any(f.anchor.endswith(":daemon-jax") for f in found)
+
+
+def test_cross_class_lock_order_cycle_through_call_index(tmp_path):
+    """A deadlock cycle only visible through the cross-class acquisition
+    closure: A holds _a and calls Bridge.relay (lock-less, another
+    module), which calls B.push, which takes _b and calls back into
+    A.grab_a — the per-class graph sees no edge at all."""
+    found = _run(tmp_path, {
+        "pkg/a.py": """\
+            import threading
+
+            class A:
+                def __init__(self, bridge):
+                    self._a = threading.Lock()
+                    self.bridge = bridge
+
+                def step(self):
+                    with self._a:
+                        self.bridge.relay()
+
+                def grab_a(self):
+                    with self._a:
+                        return 1
+            """,
+        "pkg/b.py": """\
+            import threading
+
+            class Bridge:
+                def relay(self):
+                    self.sink.push()
+
+            class B:
+                def __init__(self, peer):
+                    self._b = threading.Lock()
+                    self.peer = peer
+
+                def push(self):
+                    with self._b:
+                        self.peer.grab_a()
+            """}, [LockDiscipline(scope=None)])
+    cycles = [f for f in found if f.anchor.startswith("cycle:")]
+    assert len(cycles) == 1
+    assert "A._a" in cycles[0].message and "B._b" in cycles[0].message
+
+
+def test_concurrency_inline_suppression_and_baseline_roundtrip(tmp_path):
+    """The new rules flow through the same suppression machinery as every
+    other rule: an inline marker accepts a finding, a baseline entry
+    accepts it repo-wide, and a fixed finding reports the entry stale."""
+    _fixture(tmp_path, _SPLIT_SRC)
+    first = analyze(tmp_path, ("pkg",), [AtomicityViolation()])
+    assert len(first.findings) == 3
+
+    # inline: justify the check-then-call split next to the code
+    src = (tmp_path / "pkg" / "split.py").read_text()
+    (tmp_path / "pkg" / "split.py").write_text(src.replace(
+        "        if n == 0:\n            self.bump()",
+        "        if n == 0:\n"
+        "            # nerrflint: ok[atomicity-violation] benign:"
+        " double-bump acceptable\n"
+        "            self.bump()"))
+    second = analyze(tmp_path, ("pkg",), [AtomicityViolation()])
+    assert len(second.findings) == 2
+    assert any(f.anchor == "Counter.check_then_call:_n:split"
+               for f in second.suppressed)
+
+    # baseline: accept the rest, then fix one → its entry goes stale
+    bl = tmp_path / "bl.txt"
+    bl.write_text("".join(f"{f.key}  # accepted: single-threaded caller\n"
+                          for f in second.findings))
+    third = analyze(tmp_path, ("pkg",), [AtomicityViolation()],
+                    baseline_path=bl)
+    assert third.ok and third.findings == [] and third.stale == []
+
+    src = (tmp_path / "pkg" / "split.py").read_text()
+    (tmp_path / "pkg" / "split.py").write_text(src.replace(
+        "    def split_rmw(self):\n"
+        "        with self._lock:\n"
+        "            n = self._n\n"
+        "        with self._lock:\n"
+        "            self._n = n + 1",
+        "    def split_rmw(self):\n"
+        "        with self._lock:\n"
+        "            self._n = self._n + 1"))
+    fourth = analyze(tmp_path, ("pkg",), [AtomicityViolation()],
+                     baseline_path=bl)
+    assert fourth.findings == []
+    assert fourth.stale == ["atomicity-violation pkg/split.py "
+                            "Counter.split_rmw:_n:split"]
+
+
+def test_thread_inventory_all_package_threads_named(repo_root):
+    """The repo-wide thread audit, as data: every threading.Thread( site
+    in the package carries a name= (the satellite the rule now gates)."""
+    import ast as _ast
+
+    proj = Project(repo_root, collect_files(repo_root, ("nerrf_tpu",)))
+    sites = []
+    for mod in proj.modules.values():
+        for node in _ast.walk(mod.tree):
+            if isinstance(node, _ast.Call):
+                from nerrf_tpu.analysis.concurrency import _canonical
+
+                if _canonical(node, mod) == "threading.Thread":
+                    sites.append((mod.path, node))
+    assert len(sites) >= 6  # batcher x2, service x2, registry, metrics...
+    for path, node in sites:
+        assert any(k.arg == "name" for k in node.keywords), \
+            f"unnamed thread at {path}:{node.lineno}"
 
 
 # -- baseline round-trip ------------------------------------------------------

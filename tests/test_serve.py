@@ -594,3 +594,55 @@ def test_two_streams_share_batches_with_parity(small_model):
             auto_capacity=False, batch_size=cfg.batch_size)
         assert dets[sid].file_scores == offline.file_scores, sid
         assert dets[sid].file_window_scores == offline.file_window_scores, sid
+
+
+def test_stop_joins_nondaemon_devtime_cost_thread(small_model):
+    """Regression for the jax-on-daemon-thread hazard (thread-lifecycle
+    lint): the background cost-registration thread runs jax tracing, so it
+    must be NON-daemon (a daemon thread still inside jax at interpreter
+    teardown segfaults) and stop() must join it out — service stop leaves
+    no nerrf-devtime-costs thread running."""
+    model, params, cfg = small_model
+    # warmup skipped: this test exercises thread lifecycle, not programs
+    cfg = dataclasses.replace(cfg, warmup_on_start=False)
+    svc = OnlineDetectionService(params, model, cfg=cfg,
+                                 registry=MetricsRegistry(namespace="test"))
+    svc.start()
+    try:
+        t = svc._devtime_thread
+        assert t is not None and t.name == "nerrf-devtime-costs"
+        assert not t.daemon
+    finally:
+        svc.stop(drain=False)
+    assert svc._devtime_thread is None
+    assert not any(th.name == "nerrf-devtime-costs" and th.is_alive()
+                   for th in threading.enumerate())
+
+
+def test_raising_alert_sink_never_wedges_leave():
+    """Demux fail-open: ledger resolution runs LAST (so leave() never
+    returns before a window's alert is emitted) but must be UNCONDITIONAL
+    — a raising sink loses at most that window's alert, never the
+    resolution, else every leave() would hang to its timeout."""
+    cfg = ServeConfig(buckets=(BUCKET_B,), batch_size=4,
+                      batch_close_sec=0.02, window_sec=10.0, stride_sec=5.0)
+    svc, reg = _fake_service(cfg)  # fake score: every window is hot
+    svc.sink.emit = lambda alert: (_ for _ in ()).throw(
+        RuntimeError("operator console down"))
+    try:
+        svc.join("s0")
+        tr = _sim(seed=11, duration=60.0, files=4, rate=6.0)
+        for b in _blocks(tr, size=300):
+            svc.feed("s0", b, tr.strings)
+        t0 = time.perf_counter()
+        det = svc.leave("s0", timeout=30.0)
+        assert time.perf_counter() - t0 < 10.0  # resolved, not timed out
+    finally:
+        svc.stop(drain=False)
+    assert reg.value("serve_windows_scored_total") > 0
+    assert det.file_scores  # every scored window reached the detection
+    # each lost alert is journaled as a counted demux_drop
+    drops = [r for r in svc._journal.tail()
+             if r.kind == "demux_drop"
+             and r.data.get("reason") == "emit_error"]
+    assert drops and "RuntimeError" in drops[0].data["error"]
