@@ -661,6 +661,38 @@ def main() -> None:
         return _smoke_or_artifact("quality", "run_quality_bench.py",
                                   "quality_bench_cpu.json", surface)
 
+    def _train_health():
+        # training-health plane: the injected-divergence legs' verdicts —
+        # a clean run is untouched (bit-identical history, zero bundles,
+        # cache-deserialized step), a poisoned step fires exactly one
+        # doctor-readable train_divergence bundle
+        # (docs/training-health.md)
+        def surface(r):
+            return {
+                "steps": r.get("steps"),
+                "clean_history_bit_identical":
+                    (r.get("clean_a") or {}).get("history")
+                    == (r.get("clean_b") or {}).get("history"),
+                "clean_bundles": (r.get("clean_a") or {}).get("bundles"),
+                "clean_second_run_compile":
+                    (r.get("clean_b") or {}).get("compile_sources"),
+                "telemetry_off_compile":
+                    (r.get("telemetry_off") or {}).get("compile_sources"),
+                "faulted_bundles": (r.get("faulted") or {}).get("bundles"),
+                "faulted_trigger": (r.get("doctor") or {}).get("trigger"),
+                "faulted_doctor_ok": (r.get("doctor") or {}).get("ok"),
+                "faulted_joins_offending_step":
+                    (r.get("doctor") or {}).get("joins_offending_step"),
+                "faults_fired": r.get("faults_fired"),
+                "backend": r.get("backend"),
+                "smoke": r.get("smoke"),
+                "provenance": r.get("provenance"),
+            }
+
+        return _smoke_or_artifact("train_health",
+                                  "run_train_health_bench.py",
+                                  "train_health_bench_cpu.json", surface)
+
     def _swap():
         # model-lifecycle hot-swap: 2 streams, one mid-run swap + rollback
         def surface(r):
@@ -692,7 +724,8 @@ def main() -> None:
     for key, loader in (("corpus100h", _j100), ("adversarial", _adv),
                         ("m1_recovery", _recovery), ("tracker", _tracker),
                         ("serve", _serve), ("model_swap", _swap),
-                        ("chaos", _chaos), ("quality", _quality)):
+                        ("chaos", _chaos), ("quality", _quality),
+                        ("train_health", _train_health)):
         try:
             entry = loader()
             if entry is not None:

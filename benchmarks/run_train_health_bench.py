@@ -1,0 +1,294 @@
+#!/usr/bin/env python3
+"""Injected-divergence bench: the training-health plane end to end.
+
+Proves trainwatch's one-sentence contract on the REAL training loop: a
+healthy run is untouched by the health plane (bit-identical loss history,
+zero bundles, zero recompiles), and a poisoned step produces exactly one
+doctor-readable ``train_divergence`` bundle whose journal tail joins the
+offending step — plus the compile-cache key discipline (telemetry on/off
+resolve to DISTINCT fingerprints, repeat runs deserialize).
+
+Legs (tiny model, streaming batch path — ``NERRF_RESIDENT_MAX_BYTES=0``
+pins the loop to the path that carries the chaos point):
+
+  1. **clean A** — telemetry + monitor + flight recorder armed, step
+     routed through a fresh compile cache (source=fresh).  Zero bundles,
+     /readyz 503 before the first step and 200 after.
+  2. **clean B** — identical config, same cache: the loss history must be
+     BIT-IDENTICAL to A (the health plane observes, never perturbs), the
+     step must deserialize (source=cache — zero recompiles), zero
+     bundles.
+  3. **telemetry off** — same config with ``telemetry=False``: the cache
+     must MISS (source=fresh, distinct fingerprint) — a telemetry-off
+     executable's output treedef lacks the telemetry leaves and must
+     never serve a telemetry-on run (the deep-lint cache-key-coverage
+     axis, proven here on the live cache).
+  4. **faulted** — a seeded ``train.nonfinite_grad`` chaos spec poisons
+     one step's input with NaN: the in-step nonfinite telemetry fires,
+     EXACTLY one ``train_divergence`` bundle lands, `nerrf doctor` reads
+     it offline (training-health section + the offending step in the
+     journal tail), the loop halts, /readyz turns 503 — and the step
+     still resolved source=cache (a fault changes no shapes).
+
+    python benchmarks/run_train_health_bench.py
+    python benchmarks/run_train_health_bench.py --smoke
+    python benchmarks/run_train_health_bench.py --out results/train_health_bench_cpu.json
+
+Prints ONE JSON line (the artifact); exit 1 if any gate fails.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import urllib.request
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+FAULT_AT = 8  # hit counter: the chaos spec fires on the FAULT_AT-th step
+
+
+def _readyz(port: int) -> tuple:
+    """(status_code, reason) from a live /readyz probe."""
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/readyz", timeout=5) as r:
+            return r.status, json.loads(r.read()).get("reason")
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read()).get("reason")
+
+
+def run(steps: int = 48, smoke: bool = False,
+        log=lambda *a: print(*a, file=sys.stderr, flush=True)) -> dict:
+    """Importable harness body; returns the artifact dict."""
+    if smoke:
+        steps = 24
+    log = log or (lambda *a: None)
+    import dataclasses
+
+    import jax
+
+    from nerrf_tpu import chaos
+    from nerrf_tpu.chaos import FaultPlan, FaultSpec
+    from nerrf_tpu.compilecache import CompileCache
+    from nerrf_tpu.data import make_corpus
+    from nerrf_tpu.flight import FlightConfig, FlightRecorder
+    from nerrf_tpu.flight.doctor import format_report, read_bundle
+    from nerrf_tpu.flight.journal import DEFAULT_JOURNAL
+    from nerrf_tpu.graph import GraphConfig
+    from nerrf_tpu.models import GraphSAGEConfig, JointConfig, LSTMConfig
+    from nerrf_tpu.observability import MetricsServer
+    from nerrf_tpu.train import TrainConfig, build_dataset, train_nerrfnet
+    from nerrf_tpu.train.data import DatasetConfig
+    from nerrf_tpu.trainwatch import TrainHealthConfig, TrainHealthMonitor
+
+    backend = jax.default_backend()
+    work = tempfile.mkdtemp(prefix="nerrf-train-health-bench-")
+    prev_resident = os.environ.get("NERRF_RESIDENT_MAX_BYTES")
+    # pin the loop to the streaming batch path: the resident/scheduled
+    # flavors build their batches on device, where the chaos point's
+    # host-side poison cannot reach
+    os.environ["NERRF_RESIDENT_MAX_BYTES"] = "0"
+
+    corpus = make_corpus(3, attack_fraction=0.5, base_seed=7,
+                         duration_sec=60.0, num_target_files=4,
+                         benign_rate_hz=10.0)
+    ds = build_dataset(corpus, DatasetConfig(
+        graph=GraphConfig(window_sec=45.0, stride_sec=25.0,
+                          max_nodes=64, max_edges=128),
+        seq_len=16, max_seqs=16))
+    model_cfg = JointConfig(
+        gnn=GraphSAGEConfig(hidden=8, num_layers=1),
+        lstm=LSTMConfig(hidden=8, num_layers=1))
+    cfg = TrainConfig(model=model_cfg, batch_size=4, num_steps=steps,
+                      eval_every=1, warmup_steps=4, telemetry=True)
+    cache = CompileCache(root=os.path.join(work, "aot"), log=log)
+    journal = DEFAULT_JOURNAL  # the loop journals train_* into the default
+
+    def leg(name: str, leg_cfg, with_monitor: bool = True,
+            probe_ready: bool = False) -> dict:
+        out_dir = os.path.join(work, name)
+        seq0 = journal.seq
+        monitor = recorder = server = None
+        ready_before = ready_after = None
+        try:
+            if with_monitor:
+                monitor = TrainHealthMonitor(
+                    TrainHealthConfig(journal_every=4, min_history=4))
+                recorder = FlightRecorder(FlightConfig(out_dir=out_dir),
+                                          info=monitor.flight_info, log=log)
+                monitor.attach_flight(recorder)
+                monitor.start()
+                if probe_ready:
+                    server = MetricsServer(port=0,
+                                           ready_check=monitor.ready)
+                    ready_before = _readyz(server.port)
+            res = train_nerrfnet(ds, None, leg_cfg, monitor=monitor,
+                                 compile_cache=cache)
+            if server is not None:
+                ready_after = _readyz(server.port)
+        finally:
+            if monitor is not None:
+                monitor.stop()
+            if recorder is not None:
+                recorder.close()
+            if server is not None:
+                server.close()
+        compiles = [r.data for r in journal.tail(kinds=("compile",),
+                                                 since_seq=seq0)
+                    if r.data.get("program") == "train_step"]
+        bundles = sorted(p for p in (os.listdir(out_dir)
+                                     if os.path.isdir(out_dir) else [])
+                         if p.startswith("bundle-") and
+                         not p.endswith(".tmp"))
+        out = {
+            "history": [round(h["loss"], 8) for h in res.history],
+            "steps_logged": len(res.history),
+            "bundles": len(bundles),
+            "bundle_names": bundles,
+            "compile_sources": [c.get("source") for c in compiles],
+            "fingerprints": sorted({c.get("fingerprint")
+                                    for c in compiles}),
+            "snapshot": monitor.snapshot() if monitor is not None else None,
+            "out_dir": out_dir,
+        }
+        if ready_before is not None:
+            out["readyz_before"] = ready_before
+            out["readyz_after"] = ready_after
+        log(f"[train-health-bench] leg {name}: "
+            f"{out['steps_logged']} logged steps, "
+            f"bundles {out['bundles']}, "
+            f"compile {out['compile_sources']}")
+        return out
+
+    try:
+        clean_a = leg("clean_a", cfg, probe_ready=True)
+        clean_b = leg("clean_b", cfg)
+        off = leg("off", dataclasses.replace(cfg, telemetry=False),
+                  with_monitor=False)
+        ctl = chaos.arm(FaultPlan(seed=3, faults=(
+            FaultSpec(site="train.nonfinite_grad", mode="corrupt",
+                      at=FAULT_AT),)))
+        try:
+            faulted = leg("faulted", cfg, probe_ready=True)
+        finally:
+            chaos.disarm()
+        faults_fired = len(ctl.fired)
+
+        # offline doctor readability + the journal-tail join of the
+        # offending step (the fault_injected record's step must appear in
+        # the bundle the trigger dumped)
+        doctor = {"ok": False, "joins_offending_step": False,
+                  "trigger": None}
+        if faulted["bundles"] == 1:
+            b = read_bundle(os.path.join(faulted["out_dir"],
+                                         faulted["bundle_names"][0]))
+            report = format_report(b)
+            doctor["trigger"] = faulted["bundle_names"][0].rsplit(
+                "-", 1)[-1]
+            doctor["ok"] = (not b["missing"]
+                            and "training health:" in report
+                            and "loss tail" in report)
+            injected = [r for r in b["records"]
+                        if r.kind == "fault_injected"
+                        and r.data.get("site") == "train.nonfinite_grad"]
+            diverged = (faulted.get("snapshot") or {}).get("diverged") or {}
+            doctor["joins_offending_step"] = bool(
+                injected
+                and injected[0].data.get("step") == diverged.get("step"))
+            doctor["offending_step"] = diverged.get("step")
+    finally:
+        if prev_resident is None:
+            os.environ.pop("NERRF_RESIDENT_MAX_BYTES", None)
+        else:
+            os.environ["NERRF_RESIDENT_MAX_BYTES"] = prev_resident
+        for name in ("clean_a", "clean_b", "off", "faulted"):
+            shutil.rmtree(os.path.join(work, name), ignore_errors=True)
+        shutil.rmtree(work, ignore_errors=True)
+
+    for d in (clean_a, clean_b, off, faulted):
+        d.pop("out_dir", None)
+    return {
+        "metric": "train_health_divergence_detection",
+        "value": (faulted.get("snapshot") or {}).get("diverged", {}),
+        "unit": "divergence latched by the injected nonfinite step "
+                f"(chaos spec at hit {FAULT_AT})",
+        "backend": backend,
+        "smoke": smoke or None,
+        "steps": steps,
+        "clean_a": clean_a,
+        "clean_b": clean_b,
+        "telemetry_off": off,
+        "faulted": faulted,
+        "faults_fired": faults_fired,
+        "doctor": doctor,
+        "provenance": "python benchmarks/run_train_health_bench.py"
+                      + (" --smoke" if smoke else ""),
+    }
+
+
+def gates(result: dict) -> list:
+    """Every acceptance gate, as (name, ok) — shared by main() and the
+    artifact-of-record test."""
+    a, b = result["clean_a"], result["clean_b"]
+    off, f = result["telemetry_off"], result["faulted"]
+    on_fp = set(a["fingerprints"]) | set(b["fingerprints"])
+    return [
+        ("clean_zero_bundles", a["bundles"] == 0 and b["bundles"] == 0),
+        ("clean_history_bit_identical",
+         bool(a["history"]) and a["history"] == b["history"]),
+        ("clean_first_run_compiles_fresh",
+         a["compile_sources"] == ["fresh"]),
+        ("clean_second_run_zero_recompiles",
+         b["compile_sources"] == ["cache"]),
+        ("telemetry_off_distinct_fingerprint",
+         off["compile_sources"] == ["fresh"]
+         and not (set(off["fingerprints"]) & on_fp)),
+        ("readyz_503_before_first_step",
+         (a.get("readyz_before") or [None])[0] == 503),
+        ("readyz_200_after_clean_run",
+         (a.get("readyz_after") or [None])[0] == 200),
+        ("faulted_exactly_one_bundle", f["bundles"] == 1),
+        ("faulted_bundle_is_train_divergence",
+         result["doctor"].get("trigger") == "train_divergence"),
+        ("faulted_bundle_doctor_ok", result["doctor"].get("ok") is True),
+        ("faulted_journal_joins_offending_step",
+         result["doctor"].get("joins_offending_step") is True),
+        ("faulted_zero_recompiles", f["compile_sources"] == ["cache"]),
+        ("faulted_halted_early",
+         f["steps_logged"] < result["steps"]),
+        ("faulted_readyz_503_on_divergence",
+         (f.get("readyz_after") or [None])[0] == 503),
+        ("exactly_one_fault_fired", result["faults_fired"] == 1),
+    ]
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--steps", type=int, default=48)
+    ap.add_argument("--smoke", action="store_true",
+                    help="shorter legs (CPU pre-flight)")
+    ap.add_argument("--out", default=None, metavar="FILE",
+                    help="also write the artifact JSON here")
+    args = ap.parse_args(argv)
+
+    result = run(steps=args.steps, smoke=args.smoke)
+    print(json.dumps(result))
+    if args.out:
+        os.makedirs(os.path.dirname(os.path.abspath(args.out)),
+                    exist_ok=True)
+        with open(args.out, "w") as fh:
+            fh.write(json.dumps(result, indent=2) + "\n")
+    failed = [name for name, ok in gates(result) if not ok]
+    for name in failed:
+        print(f"[train-health-bench] GATE FAILED: {name}", file=sys.stderr)
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -89,6 +89,21 @@ REQUIRED = (
     "quality_alert_rate_z",
     "quality_calibration_margin_mass",
     "serve_alerts_emitted_total",
+    # the training-health plane (docs/training-health.md; the divergence-
+    # response runbook and run_train_health_bench's gates key off these
+    # exact names).  The first five predate trainwatch (train/loop.py's
+    # attribution gauges) and are contracted here for the first time;
+    # the rest are the monitor's live exports
+    "train_step",
+    "train_loss",
+    "train_host_blocked_fraction",
+    "train_data_wait_fraction",
+    "train_padding_waste_fraction",
+    "train_grad_norm",
+    "train_update_ratio",
+    "train_nonfinite_total",
+    "train_throughput_steps",
+    "train_data_starved_fraction",
 )
 
 _CALL = re.compile(

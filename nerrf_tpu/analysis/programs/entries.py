@@ -168,6 +168,12 @@ def cache_key_entries() -> List[CacheKeyEntry]:
     # avals IDENTICAL — precisely the drift only the `extra` key material
     # can catch (aval-changing axes are covered by the avals themselves)
     cfg_pw = dataclasses.replace(cfg, pos_weight=cfg.pos_weight + 1.0)
+    # in-step telemetry: identical argument avals, different lowered
+    # program AND output treedef — the axis PR 14's trainwatch added; a
+    # fingerprint hole here would let a telemetry-off executable (whose
+    # stored out-treedef lacks the telemetry leaves) serve a telemetry-on
+    # run
+    cfg_tel = dataclasses.replace(cfg, telemetry=True)
     base_model = cfg.model
     agg_model = dataclasses.replace(
         base_model,
@@ -175,13 +181,15 @@ def cache_key_entries() -> List[CacheKeyEntry]:
 
     t_base, t_base_extra = train_variant(cfg)
     t_pw, t_pw_extra = train_variant(cfg_pw)
+    t_tel, t_tel_extra = train_variant(cfg_tel)
     s_base, s_base_extra = serve_variant(base_model)
     s_agg, s_agg_extra = serve_variant(agg_model)
     return [
         CacheKeyEntry(
             name="train_step_flat", path=TRAIN_LOOP,
             variants=[("base", t_base, t_base_extra),
-                      ("pos_weight", t_pw, t_pw_extra)]),
+                      ("pos_weight", t_pw, t_pw_extra),
+                      ("telemetry", t_tel, t_tel_extra)]),
         CacheKeyEntry(
             name="serve_eval", path=SERVE_SERVICE,
             variants=[("base", s_base, s_base_extra),

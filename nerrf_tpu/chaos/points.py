@@ -79,6 +79,11 @@ SITES: Dict[str, str] = {
     "alerts.slow_consumer":
         "AlertSink.drain stalls delay_sec (slow operator console) — "
         "exercises bounded drop-on-full demux, scoring unaffected",
+    "train.nonfinite_grad":
+        "one streaming train step's input batch is scaled by NaN (the "
+        "non-finite value propagates through loss and gradients) — "
+        "exercises the in-step nonfinite telemetry → train_divergence "
+        "flight bundle → divergence halt, with zero recompiles",
 }
 
 # The mode(s) each point can actually EXECUTE: `inject` sites raise
@@ -98,6 +103,10 @@ SITE_MODES: Dict[str, Tuple[str, ...]] = {
     "compilecache.corrupt_payload": ("corrupt",),
     "flight.disk_full": ("error",),
     "alerts.slow_consumer": ("stall",),
+    # the point corrupts DATA (a NaN-scaled batch), not bytes: the call
+    # site uses chaos.check() and applies the poison itself, so "corrupt"
+    # is the honest mode — error would claim a raise that never happens
+    "train.nonfinite_grad": ("corrupt",),
 }
 
 

@@ -97,7 +97,10 @@ def cmd_train_detector(args) -> int:
     _log(f"training detector on {len(train_ds)} windows ({args.steps} steps)…")
     train_cfg = TrainConfig(
         model=model_cfg, batch_size=8, num_steps=args.steps,
-        learning_rate=3e-3, warmup_steps=min(30, args.steps // 5))
+        learning_rate=3e-3, warmup_steps=min(30, args.steps // 5),
+        # arming the health plane turns the in-step telemetry on with it:
+        # divergence detection without grad/update norms is loss-only
+        telemetry=(args.metrics_port >= 0 or bool(args.flight_dir)))
     compile_cache = None
     if not args.no_aot_cache:
         # persistent AOT cache (docs/compile-cache.md): a repeat run on an
@@ -106,17 +109,31 @@ def cmd_train_detector(args) -> int:
         from nerrf_tpu.compilecache import CompileCache
 
         compile_cache = CompileCache(root=args.aot_cache, log=_log)
-    if args.ckpt_every > 0:
-        from nerrf_tpu.train.elastic import train_elastic
+    # training-health plane (docs/training-health.md): /readyz with the
+    # train-aware check + train_divergence/starvation/stall bundles —
+    # both flags off costs the loop nothing
+    from nerrf_tpu.trainwatch import training_health
 
-        res = train_elastic(
-            train_ds, eval_ds, train_cfg,
-            ckpt_dir=Path(args.model_dir) / "train_state",
-            save_every=args.ckpt_every, log=_log,
-            compile_cache=compile_cache)
-    else:
-        res = train_nerrfnet(train_ds, eval_ds, train_cfg, log=_log,
-                             compile_cache=compile_cache)
+    with training_health(metrics_port=args.metrics_port,
+                         flight_dir=args.flight_dir, log=_log) as monitor:
+        if args.ckpt_every > 0:
+            from nerrf_tpu.train.elastic import train_elastic
+
+            res = train_elastic(
+                train_ds, eval_ds, train_cfg,
+                ckpt_dir=Path(args.model_dir) / "train_state",
+                save_every=args.ckpt_every, log=_log,
+                compile_cache=compile_cache, monitor=monitor)
+        else:
+            res = train_nerrfnet(train_ds, eval_ds, train_cfg, log=_log,
+                                 compile_cache=compile_cache,
+                                 monitor=monitor)
+    if not res.metrics:
+        # a divergence-halted run has no metrics and no usable weights —
+        # the flight bundle (if armed) carries the evidence
+        _log("training halted without metrics (diverged?); not saving a "
+             "checkpoint")
+        return 1
     _log(f"metrics: edge_auc={res.metrics['edge_auc']:.4f} "
          f"seq_f1={res.metrics['seq_f1']:.4f} ({res.steps_per_sec:.1f} steps/s)")
     save_checkpoint(args.model_dir, res.state.params, model_cfg)
@@ -1359,6 +1376,15 @@ def main(argv=None) -> int:
                         "the train-step executable instead of recompiling")
     p.add_argument("--no-aot-cache", action="store_true",
                    help="disable the persistent compile cache")
+    p.add_argument("--metrics-port", type=int, default=-1,
+                   help="training-health /metrics + /healthz + /readyz "
+                        "port (-1 disables; 0 = ephemeral); /readyz fails "
+                        "before the first step and on a divergence halt "
+                        "(docs/training-health.md)")
+    p.add_argument("--flight-dir", default=None, metavar="DIR",
+                   help="arm the training flight recorder: divergence/"
+                        "starvation/stall bundles land here, readable "
+                        "offline with `nerrf doctor <bundle>`")
     p.set_defaults(fn=cmd_train_detector)
 
     p = sub.add_parser("models", help="model lifecycle registry: publish, "

@@ -19,7 +19,13 @@ process, no scrape history.  The report has four sections:
      sketches + the reference profile): per-stream score PSI and
      alert-rate z, top-drifting window features, calibration margin mass
      vs the reference — a ``quality_drift`` bundle is analyzable without
-     the pod, and any other bundle answers "was the model drifting".
+     the pod, and any other bundle answers "was the model drifting";
+  7. training health — the journal tail's ``train_start`` /
+     ``train_health`` records (loss, grad norm, update ratio,
+     throughput, data-wait, nonfinite flags) plus, for ``train_*``
+     triggers, the manifest context's loss tail and last-good-checkpoint
+     restart pointer (docs/training-health.md).  Serve-side bundles
+     degrade to one line.
 
 Unreadable pieces degrade per-section (a bundle written mid-crash may
 lack a file) — partial evidence beats no report.
@@ -233,7 +239,71 @@ def format_report(bundle: dict, tail: Optional[int] = None) -> str:
 
     lines.append("")
     lines.extend(quality_section(bundle.get("quality")))
+
+    lines.append("")
+    lines.extend(train_section(bundle))
     return "\n".join(lines)
+
+
+#: journal kinds the training-health section reads
+TRAIN_KINDS = ("train_start", "train_health", "train_done")
+
+
+def train_section(bundle: dict) -> List[str]:
+    """The training-health report over a bundle's journal tail + manifest
+    (docs/training-health.md) — shared by `nerrf doctor` and the bench's
+    offline-readability gate.  Degrades to one line on a serve-side
+    bundle (no train records, non-train trigger): partial evidence beats
+    a confusing empty table."""
+    man = bundle.get("manifest") or {}
+    trigger = str(man.get("trigger") or "")
+    records = [r for r in bundle.get("records", [])
+               if r.kind in TRAIN_KINDS]
+    if not records and not trigger.startswith("train_"):
+        return ["training health: no train records in bundle "
+                "(serve-side bundle, or the run predates trainwatch)"]
+    lines = ["training health:"]
+    start = next((r for r in records if r.kind == "train_start"), None)
+    if start is not None:
+        lines.append(
+            f"  run: config={start.data.get('config_fingerprint', '-')} "
+            f"model={start.data.get('model_fingerprint', '-')} "
+            f"steps={start.data.get('steps', '-')} "
+            f"seed={start.data.get('seed', '-')}")
+    health = [r for r in records if r.kind == "train_health"]
+    if health:
+        lines.append(f"  {'step':>8} {'loss':>12} {'grad_norm':>11} "
+                     f"{'upd_ratio':>11} {'steps/s':>8} {'data_wait':>9} "
+                     f"nonfinite")
+        for r in health[-8:]:
+            d = r.data
+            nf = d.get("nonfinite") or {}
+            lines.append(
+                f"  {d.get('step', '-'):>8} {_num(d.get('loss')):>12} "
+                f"{_num(d.get('grad_norm')):>11} "
+                f"{_num(d.get('update_ratio')):>11} "
+                f"{_num(d.get('steps_per_sec')):>8} "
+                f"{_num(d.get('data_wait_fraction')):>9} "
+                + (",".join(f"{k}×{v:g}" for k, v in sorted(nf.items()))
+                   if nf else "-"))
+    else:
+        lines.append("  (no cadenced train_health records in the "
+                     "journal tail)")
+    if trigger.startswith("train_"):
+        ctx = man.get("context") or {}
+        lines.append(
+            f"  trigger: {trigger} at step {ctx.get('step', '-')}  "
+            f"last good checkpoint: "
+            f"{ctx.get('last_good_checkpoint') or '-'}")
+        tail = ctx.get("loss_tail") or []
+        if tail:
+            lines.append("  loss tail (newest last): " + " ".join(
+                f"{e.get('step')}:{_num(e.get('loss'))}"
+                for e in tail[-10:]))
+    done = next((r for r in records if r.kind == "train_done"), None)
+    if done is not None and done.data.get("halted"):
+        lines.append(f"  halted: {done.data['halted']}")
+    return lines
 
 
 def quality_section(quality: Optional[dict]) -> List[str]:

@@ -37,6 +37,10 @@ Record kinds in use (producers in parentheses):
     quality_stats     cadenced drift stats: worst score/feature PSI, margin
                       mass (quality/monitor; the quality_drift trigger edge)
     train_start/done  training-run config+model fingerprints (train/loop)
+    train_health      cadenced training health: loss, grad norm, update
+                      ratio, throughput, data-wait fraction, nonfinite
+                      flags (trainwatch/monitor; the train_divergence /
+                      train_starvation / train_stall trigger evidence)
     exception         uncaught exception captured by the crash hook
     bundle            a flight-recorder bundle was written (flight/recorder)
 

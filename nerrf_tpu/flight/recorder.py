@@ -30,7 +30,15 @@ Triggers (all evaluated in-process, no scrape loop):
     (``quality.json``) so the drift is analyzable offline;
   * ``exception``    — uncaught exception on any thread, via the
     `install_crash_handlers` sys/threading excepthook wrappers
-    (+ `faulthandler` into the bundle directory for hard crashes).
+    (+ `faulthandler` into the bundle directory for hard crashes);
+  * ``train_divergence`` / ``train_starvation`` / ``train_stall`` — the
+    training-health monitor (`nerrf_tpu/trainwatch/`) fires these
+    through `trigger()` directly; the bundle context embeds the loss/
+    grad history tail, run fingerprints, and the last-good-checkpoint
+    restart pointer, and `nerrf doctor`'s training-health section reads
+    them offline (docs/training-health.md).  Construct the recorder with
+    ``info=monitor.flight_info`` so the manifest lineage carries the run
+    identity at dump time.
 
 Every trigger is rate-limited (one bundle per ``min_interval_sec`` per
 trigger) and the bundle directory is bounded (oldest deleted beyond

@@ -103,12 +103,12 @@ def make_sharded_train_step(model: NerrfNet, cfg: "TrainConfig", mesh: Mesh,
     r_shard = replicated(mesh)
 
     def step_body(state, batch, rng):
-        rng, dropout_rng = jax.random.split(rng)
-        (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-            state.params, batch, dropout_rng
-        )
-        state = state.apply_gradients(grads=grads)
-        return state, loss, aux, rng
+        # the ONE grad/update body (loop._step_body) so the in-step
+        # telemetry axis (cfg.telemetry) can never drift per flavor —
+        # under the mesh the norm reductions become collectives, which is
+        # exactly what a sharded health reading should be
+        return loop._step_body(loss_fn, state, batch, rng,
+                               telemetry=cfg.telemetry)
 
     train_step = jax.jit(
         step_body,

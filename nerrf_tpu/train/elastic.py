@@ -146,6 +146,8 @@ def train_elastic(
     fault=None,
     log=None,
     compile_cache=None,
+    monitor=None,
+    full_history: bool = False,
 ) -> TrainResult:
     """Run (or resume) training to `cfg.num_steps` with periodic full-state
     checkpoints.  Restartable at any point; deterministic across restarts.
@@ -153,7 +155,13 @@ def train_elastic(
     ``compile_cache`` (a `compilecache.CompileCache`) matters most here:
     every supervisor restart re-pays the step compile before resuming, so
     an elastic run with the persistent cache resumes stepping in the time
-    it takes to deserialize one executable."""
+    it takes to deserialize one executable.
+
+    ``monitor`` (a `trainwatch.TrainHealthMonitor`) observes the loss +
+    in-step telemetry at every checkpoint boundary — the cadence this
+    loop already pays host syncs at — and a latched divergence halts the
+    run BEFORE the diverged state overwrites the last good checkpoint
+    (the restart pointer the divergence bundle carries)."""
     cfg = cfg or TrainConfig()
     ckpt_dir = Path(ckpt_dir)
     ckpt_dir.mkdir(parents=True, exist_ok=True)
@@ -179,14 +187,27 @@ def train_elastic(
 
         train_step = cache_train_step(compile_cache, train_step, model, cfg,
                                       "train_step_resident")
+    if monitor is not None:
+        from nerrf_tpu.flight.journal import fingerprint as _fp
+
+        monitor.set_run(config_fingerprint=_fp(cfg),
+                        model_fingerprint=_fp(cfg.model),
+                        steps=cfg.num_steps, seed=cfg.seed)
+        if start > 0:
+            monitor.note_checkpoint(ckpt_dir / f"step_{start:08d}", start)
+    from nerrf_tpu.train.loop import _history, _history_entry, \
+        _loss_components, _telemetry_floats
+
     n = len(train_ds)
-    history = []
+    history = _history(full_history)
     t_start = None
     loss = None
+    halted = None
     # Heartbeat on a wall-clock cadence (HEARTBEAT_SEC), decoupled from the
     # checkpoint interval: keyed only to saves, a supervisor with
     # timeout < save_every × step-time would restart healthy runs.
     last_hb = 0.0
+    completed = start
     for step in range(start, cfg.num_steps):
         # derived randomness: identical for step N on every (re)run
         order = np.random.default_rng((cfg.seed, step))
@@ -207,19 +228,40 @@ def train_elastic(
         if now - last_hb >= HEARTBEAT_SEC:
             _heartbeat(ckpt_dir, step)
             last_hb = now
-        done = step + 1
+        done = completed = step + 1
         if done % save_every == 0 or done == cfg.num_steps:
+            entry = _history_entry(step, loss, aux)
+            if monitor is not None:
+                # observe BEFORE saving: a divergence latched here halts
+                # the loop with the previous checkpoint still the newest
+                # good one (the bundle's restart pointer)
+                monitor.observe_step(step, entry["loss"],
+                                     telemetry=_telemetry_floats(aux),
+                                     components=_loss_components(aux))
+                if monitor.should_halt:
+                    halted = monitor.diverged
+                    if log:
+                        log(f"trainwatch: halting at step {step} — "
+                            f"{halted[1]} (last good checkpoint kept)")
+                    break
             _save_full(ckpt_dir, done, state)
-            history.append({"step": step, "loss": float(loss)})
+            if monitor is not None:
+                monitor.note_checkpoint(ckpt_dir / f"step_{done:08d}", done)
+            history.append(entry)
             if log:
-                log(f"step {step}: loss={float(loss):.4f} (checkpointed)")
+                log(f"step {step}: loss={entry['loss']:.4f} (checkpointed)")
 
     sync_result(state.params)
+    if monitor is not None:
+        monitor.finish()  # post-training eval must not read as a stall
     elapsed = time.perf_counter() - (t_start or time.perf_counter())
-    steps = cfg.num_steps - start
+    # steps actually run (a divergence halt breaks out early — dividing
+    # by the CONFIGURED count would overstate throughput by the skipped
+    # fraction)
+    steps = completed - start
     steps_per_sec = max(steps - 1, 1) / elapsed if elapsed > 0 else 0.0
-    metrics = evaluate(
+    metrics = ({} if halted is not None else evaluate(
         make_eval_fn(model), state.params,
-        eval_ds if eval_ds is not None else train_ds, cfg.batch_size)
+        eval_ds if eval_ds is not None else train_ds, cfg.batch_size))
     return TrainResult(state=state, metrics=metrics,
-                       steps_per_sec=steps_per_sec, history=history)
+                       steps_per_sec=steps_per_sec, history=list(history))

@@ -17,6 +17,7 @@ from __future__ import annotations
 import contextlib
 import dataclasses
 import time
+from collections import deque
 from functools import partial
 from pathlib import Path
 from typing import Any, Dict, Optional
@@ -50,6 +51,12 @@ class TrainConfig:
     pos_weight: float = 8.0  # attack classes are rare
     seed: int = 0
     eval_every: int = 100
+    # in-step health telemetry (trainwatch): grad/param/update norms +
+    # per-component nonfinite flags computed INSIDE the jitted step and
+    # returned alongside the loss.  Changes the lowered program and its
+    # output treedef, so it rides the compile-cache key (step_key_extra
+    # carries repr(cfg) AND an explicit "telemetry" axis)
+    telemetry: bool = False
 
 
 @dataclasses.dataclass
@@ -102,14 +109,27 @@ def make_loss_fn(model: NerrfNet, cfg: TrainConfig):
     return loss_fn
 
 
-def _step_body(loss_fn, state: train_state.TrainState, batch, rng):
-    """The one grad/update body shared by every batching strategy."""
+def _step_body(loss_fn, state: train_state.TrainState, batch, rng,
+               telemetry: bool = False):
+    """The one grad/update body shared by every batching strategy.
+
+    ``telemetry`` (static at trace time — `TrainConfig.telemetry`) adds
+    the in-step health scalars (trainwatch/telemetry.py) to ``aux`` under
+    the reserved ``"telemetry"`` key: same program outputs carry the
+    grad/param/update norms and nonfinite flags, so the host reads them
+    at the sync points it already pays — zero extra device round trips."""
     rng, dropout_rng = jax.random.split(rng)
     (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
         state.params, batch, dropout_rng
     )
-    state = state.apply_gradients(grads=grads)
-    return state, loss, aux, rng
+    new_state = state.apply_gradients(grads=grads)
+    # nerrflint: ok[recompile-hazard] telemetry is STATIC configuration (a Python bool bound by partial/closure from TrainConfig.telemetry, never a traced value) and the axis rides the compile-cache key (step_key_extra)
+    if telemetry:
+        from nerrf_tpu.trainwatch.telemetry import step_telemetry
+
+        aux = dict(aux, telemetry=step_telemetry(
+            state.params, new_state.params, grads, loss, aux))
+    return new_state, loss, aux, rng
 
 
 def make_train_step(model: NerrfNet, cfg: TrainConfig):
@@ -117,7 +137,8 @@ def make_train_step(model: NerrfNet, cfg: TrainConfig):
 
     @partial(jax.jit, donate_argnums=(0,))
     def train_step(state: train_state.TrainState, batch, rng):
-        return _step_body(loss_fn, state, batch, rng)
+        return _step_body(loss_fn, state, batch, rng,
+                          telemetry=cfg.telemetry)
 
     return train_step
 
@@ -194,7 +215,8 @@ def make_flat_train_step(model: NerrfNet, cfg: TrainConfig):
     """The cacheable twin of `make_train_step`: same grad/update body, flat
     (params, opt_state, step, batch, rng) boundary — see `make_flat_step`."""
     loss_fn = make_loss_fn(model, cfg)
-    return make_flat_step(model, cfg, partial(_step_body, loss_fn))
+    return make_flat_step(
+        model, cfg, partial(_step_body, loss_fn, telemetry=cfg.telemetry))
 
 
 def cache_train_step(compile_cache, train_step, model: NerrfNet,
@@ -306,7 +328,8 @@ def _make_resident_steps(model: NerrfNet, cfg: TrainConfig, arrays):
 
     def gathered_step(state, idx, rng, data):
         batch = {k: jnp.take(v, idx, axis=0) for k, v in data.items()}
-        return _step_body(loss_fn, state, batch, rng)
+        return _step_body(loss_fn, state, batch, rng,
+                          telemetry=cfg.telemetry)
 
     @partial(jax.jit, donate_argnums=(0,))
     def step_by_idx(state: train_state.TrainState, idx, rng, data):
@@ -384,6 +407,10 @@ def step_key_extra(cfg: TrainConfig, flavor: str) -> dict:
         "train_cfg": repr(cfg),
         "ops": repr(sorted(active_impls().items())),
         "donate": "(params,opt_state)",
+        # explicit (already inside repr(cfg), but this axis changes the
+        # program's OUTPUT TREEDEF too — a deserialized executable only
+        # accepts equal treedefs, so the key must never collapse it)
+        "telemetry": "on" if cfg.telemetry else "off",
     }
 
 
@@ -401,6 +428,47 @@ def make_idx_schedule(n: int, cfg: TrainConfig) -> np.ndarray:
 # Datasets larger than this stream batches from host instead of living in
 # device memory (override: NERRF_RESIDENT_MAX_BYTES).
 RESIDENT_MAX_BYTES = 2 << 30
+
+# Bounded in-memory loss history: a long soak logging every eval_every
+# steps must not grow a list for the life of the run.  Callers that need
+# the complete trajectory (tests, offline analysis) pass
+# ``full_history=True``; everyone else gets the newest HISTORY_LIMIT
+# entries (TrainResult.history stays a plain list either way).
+HISTORY_LIMIT = 512
+
+
+def _history(full_history: bool) -> deque:
+    return deque(maxlen=None if full_history else HISTORY_LIMIT)
+
+
+def _history_entry(step: int, loss, aux) -> dict:
+    """One logged-step history entry.  Floats the loss (the loop's one
+    existing host sync point) and, when the step carries in-step
+    telemetry, the headline health scalars with it — same sync, no extra
+    device round trip."""
+    entry = {"step": step, "loss": float(loss)}
+    tel = aux.get("telemetry") if isinstance(aux, dict) else None
+    if tel is not None:
+        entry["grad_norm"] = float(tel["grad_norm"])
+        entry["update_ratio"] = float(tel["update_ratio"])
+    return entry
+
+
+def _loss_components(aux) -> Dict[str, float]:
+    return {k: float(v) for k, v in aux.items() if k != "telemetry"}
+
+
+def _telemetry_floats(aux) -> Optional[dict]:
+    tel = aux.get("telemetry") if isinstance(aux, dict) else None
+    if tel is None:
+        return None
+    return {
+        "grad_norm": float(tel["grad_norm"]),
+        "param_norm": float(tel["param_norm"]),
+        "update_norm": float(tel["update_norm"]),
+        "update_ratio": float(tel["update_ratio"]),
+        "nonfinite": {k: float(v) for k, v in tel["nonfinite"].items()},
+    }
 
 
 def _dataset_bytes(arrays) -> int:
@@ -551,12 +619,21 @@ def train_nerrfnet(
     cfg: Optional[TrainConfig] = None,
     log=None,
     compile_cache=None,
+    monitor=None,
+    full_history: bool = False,
 ) -> TrainResult:
     """``compile_cache`` (a `compilecache.CompileCache`) routes the jitted
     train step through the persistent AOT cache: a repeat run on an
     unchanged config deserializes the step executable instead of paying
     the flagship compile (130 s at BENCH_r04 shapes) before step 0.
-    Fail-open — any cache problem falls back to the live jit path."""
+    Fail-open — any cache problem falls back to the live jit path.
+
+    ``monitor`` (a `trainwatch.TrainHealthMonitor`) observes every logged
+    step — loss, in-step telemetry floats, accumulated data-wait — at the
+    loop's existing host sync point, and can halt the loop once a
+    divergence latches (NaN weights cannot recover; see
+    docs/training-health.md).  A halted run skips the final eval and
+    returns empty metrics."""
     cfg = cfg or TrainConfig()
     model = NerrfNet(cfg.model)
     # config+model fingerprints into the flight journal: a run's identity
@@ -569,6 +646,12 @@ def train_nerrfnet(
         model_fingerprint=fingerprint(cfg.model),
         steps=cfg.num_steps, batch_size=cfg.batch_size,
         windows=len(train_ds), seed=cfg.seed)
+    if monitor is not None:
+        # run identity into the monitor: every train trigger's bundle
+        # carries the same fingerprints the journal already stamps
+        monitor.set_run(config_fingerprint=fingerprint(cfg),
+                        model_fingerprint=fingerprint(cfg.model),
+                        steps=cfg.num_steps, seed=cfg.seed)
     rng = jax.random.PRNGKey(cfg.seed)
     rng, init_rng = jax.random.split(rng)
     with DEFAULT_TRACER.span("train_setup", device=True):
@@ -599,7 +682,7 @@ def train_nerrfnet(
                                       "train_step_scheduled")
 
     order_rng = np.random.default_rng(cfg.seed)
-    history = []
+    history = _history(full_history)
     # step-time attribution: padding waste is knowable before the first
     # step (static shapes make padded slots cost real compute), the
     # host-blocked / data-wait split only when per-step spans sync — so
@@ -615,6 +698,9 @@ def train_nerrfnet(
             help="fraction of padded capacity carrying no real data")
     blocked_s = 0.0
     data_wait_s = 0.0
+    dw_accum = 0.0  # data wait since the monitor's last observation
+    steps_done = 0
+    halted = None
     # warmup/compile step excluded from timing
     t_start = None
     with tracer.span("train_loop", steps=cfg.num_steps, resident=resident,
@@ -623,6 +709,7 @@ def train_nerrfnet(
             if not resident:
                 dw_cm = tracer.span("data_wait", step=step) if trace_steps \
                     else contextlib.nullcontext()
+                t_dw = time.perf_counter() if monitor is not None else None
                 with dw_cm as dw:
                     idx = order_rng.choice(
                         n, size=min(cfg.batch_size, n), replace=False)
@@ -632,6 +719,21 @@ def train_nerrfnet(
                 # steps/s convention of measuring steady state only
                 if dw is not None and step > 0:
                     data_wait_s += dw.dur
+                if t_dw is not None and step > 0:
+                    dw_accum += time.perf_counter() - t_dw
+                # chaos fault point (disarmed = one global None read):
+                # poison this step's input with NaN — the non-finite
+                # value propagates through loss and gradients, so the
+                # in-step nonfinite telemetry must fire and the monitor
+                # must dump exactly one train_divergence bundle.  Same
+                # shapes, same program: the zero-recompile contract holds
+                from nerrf_tpu import chaos
+
+                if chaos.check("train.nonfinite_grad", key=str(step),
+                               step=step) is not None:
+                    batch = dict(
+                        batch,
+                        node_feat=batch["node_feat"] * jnp.float32(np.nan))
             step_args = (state, rng) if resident else (state, batch, rng)
             if trace_steps:
                 # fetch-synced step: the span measures until the loss
@@ -653,20 +755,42 @@ def train_nerrfnet(
                 # nerrflint: ok[sync-in-hot-loop] step-0 compile barrier
                 sync_result(loss)
                 t_start = time.perf_counter()
+            steps_done = step + 1
             if step % cfg.eval_every == 0 or step == cfg.num_steps - 1:
-                history.append({"step": step, "loss": float(loss)})
+                entry = _history_entry(step, loss, aux)
+                history.append(entry)
                 DEFAULT_REGISTRY.gauge_set("train_step", step,
                                            help="last completed train step")
                 DEFAULT_REGISTRY.gauge_set(
-                    "train_loss", float(loss),
+                    "train_loss", entry["loss"],
                     help="joint loss at last logged step")
                 if log:
-                    log(f"step {step}: loss={float(loss):.4f} "
-                        + " ".join(f"{k}={float(v):.4f}"
-                                   for k, v in aux.items()))
+                    log(f"step {step}: loss={entry['loss']:.4f} "
+                        + " ".join(f"{k}={v:.4f}"
+                                   for k, v in
+                                   _loss_components(aux).items()))
+                if monitor is not None:
+                    monitor.observe_step(
+                        step, entry["loss"],
+                        telemetry=_telemetry_floats(aux),
+                        data_wait_s=dw_accum,
+                        components=_loss_components(aux))
+                    dw_accum = 0.0
+                    if monitor.should_halt:
+                        halted = monitor.diverged
+                        if log:
+                            log(f"trainwatch: halting at step {step} — "
+                                f"{halted[1]} (bundle dumped; resume from "
+                                f"the last good checkpoint)")
+                        break
         sync_result(state.params)
+    if monitor is not None:
+        # stepping is over: post-training eval/calibration can run for
+        # minutes and must not read as a train_stall
+        monitor.finish()
     elapsed = time.perf_counter() - (t_start or time.perf_counter())
-    steps_per_sec = (cfg.num_steps - 1) / elapsed if elapsed > 0 else 0.0
+    steps_per_sec = ((steps_done - 1) / elapsed
+                     if elapsed > 0 and steps_done > 1 else 0.0)
     if trace_steps and elapsed > 0 and cfg.num_steps > 1:
         # same denominator as steps_per_sec (post-step-0 steady state), so
         # the fractions attribute the time the headline number measures —
@@ -693,20 +817,29 @@ def train_nerrfnet(
     if eff and log:
         log(f"device efficiency: {eff}")
 
-    metrics = evaluate(
-        eval_fn, state.params, eval_ds if eval_ds is not None else train_ds,
-        cfg.batch_size,
-        # evaluating the train set: its arrays are already device-resident
-        # in the train-step closure — a second resident upload would double
-        # HBM, so stream per batch in that (diagnostic) case
-        resident=None if eval_ds is not None else False,
-    )
+    if halted is not None:
+        # diverged weights: evaluating NaN params would only fabricate
+        # metrics — return empty ones and let the journal say why
+        metrics = {}
+    else:
+        metrics = evaluate(
+            eval_fn, state.params,
+            eval_ds if eval_ds is not None else train_ds,
+            cfg.batch_size,
+            # evaluating the train set: its arrays are already
+            # device-resident in the train-step closure — a second
+            # resident upload would double HBM, so stream per batch in
+            # that (diagnostic) case
+            resident=None if eval_ds is not None else False,
+        )
     DEFAULT_JOURNAL.record(
         "train_done", config_fingerprint=fingerprint(cfg),
         steps_per_sec=round(steps_per_sec, 3),
+        steps_done=steps_done,
+        **({"halted": halted[1]} if halted is not None else {}),
         metrics={k: round(float(v), 4) for k, v in metrics.items()})
     return TrainResult(state=state, metrics=metrics, steps_per_sec=steps_per_sec,
-                       history=history)
+                       history=list(history))
 
 
 def train_sharded_stream(
@@ -719,6 +852,8 @@ def train_sharded_stream(
     save_every: int = 0,
     upload_chunk_bytes: int = 64 << 20,
     compile_cache=None,
+    monitor=None,
+    full_history: bool = False,
 ) -> TrainResult:
     """100 h-scale training: rotate disk shards through HBM, double-buffered.
 
@@ -763,7 +898,8 @@ def train_sharded_stream(
             k: v.astype(jnp.float32) if v.dtype == jnp.float16 else v
             for k, v in batch.items()
         }
-        return _step_body(loss_fn, state, batch, rng)
+        return _step_body(loss_fn, state, batch, rng,
+                          telemetry=cfg.telemetry)
 
     step_by_idx = jax.jit(stream_body, donate_argnums=(0,))
 
@@ -805,27 +941,42 @@ def train_sharded_stream(
                               name="nerrf-train-reader")
     thread.start()
 
+    dw_accum = [0.0]  # shard-queue wait since the monitor's last look
+
     def next_host_shard():
         # data_wait: host blocked on the disk-reader thread — when this
         # span dominates the trace the reader, not the chip, is the
-        # bottleneck
-        with DEFAULT_TRACER.span("data_wait", source="shard_queue"):
-            while True:
-                try:
-                    item = host_q.get(timeout=5.0)
-                except queue_mod.Empty:
-                    if not thread.is_alive():
+        # bottleneck (the same accumulated seconds feed the monitor's
+        # train_starvation trigger)
+        t_dw = time.perf_counter()
+        try:
+            with DEFAULT_TRACER.span("data_wait", source="shard_queue"):
+                while True:
+                    try:
+                        item = host_q.get(timeout=5.0)
+                    except queue_mod.Empty:
+                        if not thread.is_alive():
+                            raise RuntimeError(
+                                "corpus reader thread died without "
+                                "reporting")
+                        continue
+                    if isinstance(item, BaseException):
                         raise RuntimeError(
-                            "corpus reader thread died without reporting")
-                    continue
-                if isinstance(item, BaseException):
-                    raise RuntimeError("corpus shard read failed") from item
-                return item
+                            "corpus shard read failed") from item
+                    return item
+        finally:
+            dw_accum[0] += time.perf_counter() - t_dw
 
     rng = jax.random.PRNGKey(cfg.seed)
     rng, init_rng = jax.random.split(rng)
     shard = put_chunked(next_host_shard(), block=True)
     state = init_state(model, cfg, shard, init_rng)
+    if monitor is not None:
+        from nerrf_tpu.flight.journal import fingerprint as _fp
+
+        monitor.set_run(config_fingerprint=_fp(cfg),
+                        model_fingerprint=_fp(cfg.model),
+                        steps=cfg.num_steps, seed=cfg.seed)
 
     steps_done = 0
     if ckpt_dir is not None and save_every > 0:
@@ -839,12 +990,13 @@ def train_sharded_stream(
                 log(f"resumed from step {resumed}")
 
     order = np.random.default_rng((cfg.seed, steps_done))
-    history = []
+    history = _history(full_history)
     t_start = None
     timed_from = steps_done
     loss = None
+    halted = None
     try:
-        while steps_done < cfg.num_steps:
+        while steps_done < cfg.num_steps and halted is None:
             # stage the next shard: async upload overlaps this shard's steps
             nxt = put_chunked(next_host_shard()) \
                 if steps_done + _shard_steps(shard, cfg, passes_per_shard) \
@@ -863,15 +1015,34 @@ def train_sharded_stream(
                     t_start = time.perf_counter()
                     timed_from = steps_done
                 if cfg.eval_every and steps_done % cfg.eval_every == 0:
-                    history.append({"step": steps_done, "loss": float(loss)})
+                    entry = _history_entry(steps_done, loss, aux)
+                    history.append(entry)
                     if log:
-                        log(f"step {steps_done}: loss={float(loss):.4f} "
-                            + " ".join(f"{k}={float(v):.4f}"
-                                       for k, v in aux.items()))
+                        log(f"step {steps_done}: loss={entry['loss']:.4f} "
+                            + " ".join(f"{k}={v:.4f}"
+                                       for k, v in
+                                       _loss_components(aux).items()))
+                    if monitor is not None:
+                        monitor.observe_step(
+                            steps_done, entry["loss"],
+                            telemetry=_telemetry_floats(aux),
+                            data_wait_s=dw_accum[0],
+                            components=_loss_components(aux))
+                        dw_accum[0] = 0.0
+                        if monitor.should_halt:
+                            halted = monitor.diverged
+                            if log:
+                                log(f"trainwatch: halting at step "
+                                    f"{steps_done} — {halted[1]}")
+                            break
                 steps_done += 1
                 if (ckpt_dir is not None and save_every > 0
                         and steps_done % save_every == 0):
                     _save_full(Path(ckpt_dir), steps_done, state)
+                    if monitor is not None:
+                        monitor.note_checkpoint(
+                            Path(ckpt_dir) / f"step_{steps_done:08d}",
+                            steps_done)
             if nxt is not None:
                 shard = nxt
     finally:
@@ -884,17 +1055,21 @@ def train_sharded_stream(
         thread.join(timeout=10)
 
     sync_result(state.params)
-    if ckpt_dir is not None and save_every > 0:
+    if monitor is not None:
+        monitor.finish()  # post-training eval must not read as a stall
+    if ckpt_dir is not None and save_every > 0 and halted is None:
+        # a diverged run must not overwrite the last GOOD checkpoint with
+        # NaN weights — the bundle's pointer is the restart point
         _save_full(Path(ckpt_dir), steps_done, state)
     elapsed = time.perf_counter() - (t_start or time.perf_counter())
     timed = max(steps_done - timed_from - 1, 1)
     steps_per_sec = timed / elapsed if elapsed > 0 else 0.0
     metrics = (
         evaluate(make_eval_fn(model), state.params, eval_ds, cfg.batch_size)
-        if eval_ds is not None else {}
+        if eval_ds is not None and halted is None else {}
     )
     return TrainResult(state=state, metrics=metrics,
-                       steps_per_sec=steps_per_sec, history=history)
+                       steps_per_sec=steps_per_sec, history=list(history))
 
 
 def _shard_steps(shard, cfg: TrainConfig, passes: int) -> int:

@@ -36,7 +36,49 @@ def run_experiment(name_or_path: str, out_dir: str | Path,
                    calibrate: bool = True,
                    publish_to: str | None = None,
                    lineage: str = "default",
-                   compile_cache=None) -> dict:
+                   compile_cache=None,
+                   metrics_port: int = -1,
+                   flight_dir: str | None = None) -> dict:
+    """``metrics_port`` ≥ 0 / ``flight_dir`` arm the training-health plane
+    (docs/training-health.md): a /metrics+/readyz endpoint with the
+    train-aware ready check (503 before the first step and on a
+    divergence halt) and train-side flight triggers dumping
+    doctor-readable bundles.  Both off (the defaults) costs the loop
+    nothing."""
+    from nerrf_tpu.trainwatch import training_health
+
+    with training_health(metrics_port=metrics_port, flight_dir=flight_dir,
+                         log=_log) as monitor:
+        return _run_experiment(name_or_path, out_dir, num_steps, ckpt_every,
+                               sharded, calibrate, publish_to, lineage,
+                               compile_cache, monitor)
+
+
+def _halted_report(exp, cfg, out: "Path", monitor, steps_per_sec) -> dict:
+    """The divergence-halt exit: a run the monitor stopped has NaN
+    weights — saving, calibrating, or publishing them would hand a
+    poisoned checkpoint to the registry.  Write a metrics.json that says
+    exactly why there is no model, with a failing gate so the caller
+    exits non-zero.  The restart pointer lives in the flight bundle."""
+    step, reason = monitor.diverged
+    report = {
+        "experiment": exp.name,
+        "num_steps": cfg.num_steps,
+        "steps_per_sec": round(steps_per_sec, 3),
+        "metrics": {},
+        "diverged": {"step": step, "reason": reason},
+        "gates": {"not_diverged": False},
+    }
+    (out / "metrics.json").write_text(json.dumps(report, indent=2) + "\n")
+    _log(f"training diverged at step {step} ({reason}); NOT saving a "
+         f"checkpoint — restart from the last good checkpoint (see the "
+         f"flight bundle)")
+    return report
+
+
+def _run_experiment(name_or_path, out_dir, num_steps, ckpt_every, sharded,
+                    calibrate, publish_to, lineage, compile_cache,
+                    monitor) -> dict:
     import dataclasses
 
     import jax
@@ -49,6 +91,12 @@ def run_experiment(name_or_path: str, out_dir: str | Path,
     cfg = exp.train
     if num_steps is not None:
         cfg = dataclasses.replace(cfg, num_steps=num_steps)
+    if monitor is not None and not cfg.telemetry:
+        # the health plane is armed: turn the in-step telemetry on with
+        # it (divergence detection without grad/update norms is
+        # loss-only).  A distinct compile-cache fingerprint by design —
+        # telemetry changes the step's lowered program and output treedef
+        cfg = dataclasses.replace(cfg, telemetry=True)
     out = Path(out_dir)
     out.mkdir(parents=True, exist_ok=True)
     exp.save(out / "experiment.json")
@@ -85,9 +133,12 @@ def run_experiment(name_or_path: str, out_dir: str | Path,
             res = train_sharded_stream(
                 sc, cfg, eval_ds=eval_ds, log=_log,
                 ckpt_dir=(out / "train_state") if ckpt_every > 0 else None,
-                save_every=ckpt_every, compile_cache=compile_cache)
+                save_every=ckpt_every, compile_cache=compile_cache,
+                monitor=monitor)
             metrics, steps_per_sec, params = (
                 res.metrics, res.steps_per_sec, res.state.params)
+            if monitor is not None and monitor.diverged is not None:
+                return _halted_report(exp, cfg, out, monitor, steps_per_sec)
             corpus_extra = {
                 "corpus_hours": round(sc.hours, 2),
                 "corpus_train_windows": sc.train_windows,
@@ -132,6 +183,7 @@ def run_experiment(name_or_path: str, out_dir: str | Path,
         order = np.random.default_rng(cfg.seed)
         b = max(cfg.batch_size, n_dev)
         t_start = None
+        steps_done = 0
         for i in range(cfg.num_steps):
             idx = order.choice(len(train_ds), size=b, replace=len(train_ds) < b)
             batch = shard_batch(mesh, {k: v[idx] for k, v in train_ds.arrays.items()})
@@ -140,9 +192,32 @@ def run_experiment(name_or_path: str, out_dir: str | Path,
                 # nerrflint: ok[sync-in-hot-loop] step-0 compile barrier
                 sync_result(loss)
                 t_start = time.perf_counter()
+            steps_done = i + 1
+            if monitor is not None and (i % cfg.eval_every == 0
+                                        or i == cfg.num_steps - 1):
+                # same cadence/sync contract as the other loops: the
+                # monitor observes at logged steps, where the loss is
+                # floated anyway — /readyz flips ready after step 0
+                # instead of 503ing a healthy multi-hour sharded run
+                from nerrf_tpu.train.loop import (
+                    _loss_components,
+                    _telemetry_floats,
+                )
+
+                monitor.observe_step(
+                    i, float(loss), telemetry=_telemetry_floats(aux),
+                    components=_loss_components(aux))
+                if monitor.should_halt:
+                    _log(f"trainwatch: halting sharded run at step {i} — "
+                         f"{monitor.diverged[1]}")
+                    break
         sync_result(state.params)
-        steps_per_sec = (cfg.num_steps - 1) / max(
+        if monitor is not None:
+            monitor.finish()
+        steps_per_sec = max(steps_done - 1, 1) / max(
             time.perf_counter() - (t_start or 0), 1e-9)
+        if monitor is not None and monitor.diverged is not None:
+            return _halted_report(exp, cfg, out, monitor, steps_per_sec)
         if jax.process_count() > 1:
             # host-side eval pulls full arrays, which only exists per-process
             # in a multi-controller run; report the (replicated) final loss
@@ -162,17 +237,19 @@ def run_experiment(name_or_path: str, out_dir: str | Path,
         res = train_elastic(train_ds, eval_ds, cfg,
                             ckpt_dir=out / "train_state",
                             save_every=ckpt_every, log=_log,
-                            compile_cache=compile_cache)
+                            compile_cache=compile_cache, monitor=monitor)
         metrics, steps_per_sec, params = (
             res.metrics, res.steps_per_sec, res.state.params)
     else:
         from nerrf_tpu.train.loop import train_nerrfnet
 
         res = train_nerrfnet(train_ds, eval_ds, cfg, log=_log,
-                             compile_cache=compile_cache)
+                             compile_cache=compile_cache, monitor=monitor)
         metrics, steps_per_sec, params = (
             res.metrics, res.steps_per_sec, res.state.params)
 
+    if monitor is not None and monitor.diverged is not None:
+        return _halted_report(exp, cfg, out, monitor, steps_per_sec)
     return _finish(exp, cfg, out, n_dev, metrics, steps_per_sec, params, t0,
                    corpus_extra, calibrate=calibrate,
                    publish_to=publish_to, lineage=lineage)
@@ -278,6 +355,19 @@ def main(argv=None) -> int:
     ap.add_argument("--no-aot-cache", action="store_true",
                     help="disable the persistent compile cache (every run "
                          "pays the full train-step compile)")
+    ap.add_argument("--metrics-port", type=int, default=-1,
+                    help="training-health /metrics + /healthz + /readyz "
+                         "port (-1 disables; 0 = ephemeral).  /readyz is "
+                         "train-aware: 503 before the first completed "
+                         "step and after a divergence halt "
+                         "(docs/training-health.md)")
+    ap.add_argument("--flight-dir", default=None, metavar="DIR",
+                    help="arm the training flight recorder: "
+                         "train_divergence / train_starvation / "
+                         "train_stall triggers dump self-contained "
+                         "bundles here (loss/grad history tail, run "
+                         "fingerprints, last-good checkpoint pointer), "
+                         "readable offline with `nerrf doctor <bundle>`")
     args = ap.parse_args(argv)
     # Multi-host: join the cluster BEFORE any backend use.  Set
     # NERRF_COORDINATOR/NERRF_NUM_PROCESSES/NERRF_PROCESS_ID per process
@@ -328,7 +418,9 @@ def main(argv=None) -> int:
     report = run_experiment(args.experiment, args.out, args.steps,
                             args.ckpt_every, publish_to=args.publish,
                             lineage=args.lineage,
-                            compile_cache=compile_cache)
+                            compile_cache=compile_cache,
+                            metrics_port=args.metrics_port,
+                            flight_dir=args.flight_dir)
     return 0 if all(report["gates"].values()) else 1
 
 

@@ -1,0 +1,83 @@
+"""trainwatch: the training-health observability plane.
+
+Every other plane (spans, SLO/flight, devtime, quality) faces the serve
+path; this one faces the training run — in-step telemetry computed inside
+the jitted step (`telemetry.step_telemetry`), a `TrainHealthMonitor`
+exporting ``nerrf_train_*`` gauges + cadenced ``train_health`` journal
+records, and train-side flight triggers (``train_divergence`` /
+``train_starvation`` / ``train_stall``) dumping doctor-readable bundles
+through the existing `FlightRecorder`.  See docs/training-health.md.
+
+`training_health` is the one wiring point the CLIs share: it builds the
+monitor, the train-aware `/readyz` metrics server, and the flight
+recorder from two flags (``--metrics-port`` / ``--flight-dir``) and tears
+everything down in order on exit.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+from nerrf_tpu.trainwatch.monitor import (  # noqa: F401
+    TrainHealthConfig,
+    TrainHealthMonitor,
+)
+from nerrf_tpu.trainwatch.telemetry import (  # noqa: F401
+    global_norm,
+    nonfinite_count,
+    step_telemetry,
+)
+
+
+@contextlib.contextmanager
+def training_health(metrics_port=None, flight_dir=None,
+                    cfg=None, registry=None, journal=None, log=None):
+    """Wire the training-health plane for one run; yields the monitor
+    (None when both surfaces are disabled — the loop then pays nothing).
+
+    * ``metrics_port`` ≥ 0 → a `MetricsServer` with the train-aware
+      ``ready_check`` (503 before the first step and after a
+      divergence halt);
+    * ``flight_dir`` set → a `FlightRecorder` whose ``info()`` is the
+      monitor's run identity; train triggers dump bundles there.
+
+    Teardown order matters and is owned here: monitor thread first (it
+    may fire into the recorder), then the recorder's journal
+    subscription, then the HTTP server.
+    """
+    if (metrics_port is None or metrics_port < 0) and not flight_dir:
+        yield None
+        return
+    monitor = TrainHealthMonitor(cfg, registry=registry, journal=journal,
+                                 log=log)
+    recorder = None
+    server = None
+    try:
+        if flight_dir:
+            from nerrf_tpu.flight import FlightConfig, FlightRecorder
+
+            recorder = FlightRecorder(
+                FlightConfig(out_dir=str(flight_dir)),
+                registry=registry, journal=journal,
+                info=monitor.flight_info, log=log)
+            monitor.attach_flight(recorder)
+            if log:
+                log(f"trainwatch: flight recorder armed, bundles in "
+                    f"{flight_dir}")
+        if metrics_port is not None and metrics_port >= 0:
+            from nerrf_tpu.observability import MetricsServer
+
+            server = MetricsServer(registry=registry, host="0.0.0.0",
+                                   port=metrics_port,
+                                   ready_check=monitor.ready)
+            if log:
+                log(f"trainwatch: metrics on :{server.port} "
+                    f"(/metrics, /healthz, /readyz)")
+        monitor.start()
+        yield monitor
+    finally:
+        monitor.stop()
+        if recorder is not None:
+            recorder.close()
+        if server is not None:
+            server.close()

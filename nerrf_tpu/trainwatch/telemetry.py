@@ -1,0 +1,74 @@
+"""In-step training telemetry: pure-JAX health scalars computed INSIDE the
+jitted train step.
+
+`step_telemetry` runs in the traced step body (``loop._step_body`` calls it
+when ``TrainConfig.telemetry`` is on) and returns a small pytree of scalars
+that rides the step's existing output alongside the loss — so the host
+fetches it at exactly the sync points it already pays (the logged-step
+``float(loss)``), never an extra device round trip:
+
+  * ``grad_norm``      — global L2 norm of the raw gradients (pre-clip:
+    the optimizer clips at 1.0, so the *unclipped* norm is the early-
+    warning signal — a clipped norm saturates exactly when it matters);
+  * ``param_norm``     — global L2 norm of the pre-update parameters;
+  * ``update_norm`` / ``update_ratio`` — ‖Δθ‖ and ‖Δθ‖/‖θ‖, the
+    effective-learning-rate reading (a collapsing ratio means the run
+    stopped moving; an exploding one precedes divergence);
+  * ``nonfinite``      — per-loss-component NaN/Inf flags (edge/node/seq
+    + total) and the COUNT of non-finite gradient elements.  These are
+    the `train_divergence` trigger's hard edge: a single non-finite
+    anywhere is an incident, not a statistic.
+
+Telemetry on/off changes the step's lowered program AND its output
+treedef, so it must (and does) ride the compile-cache key:
+``TrainConfig.telemetry`` is part of ``repr(cfg)`` in
+``loop.step_key_extra`` and is additionally stamped as an explicit
+``telemetry`` key — a cached telemetry-off executable can never serve a
+telemetry-on run (deep-lint cache-key-coverage proves the axis).
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+
+
+def global_norm(tree) -> jnp.ndarray:
+    """Global L2 norm over a pytree of arrays (f32 accumulation)."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    if not leaves:
+        return jnp.float32(0.0)
+    return jnp.sqrt(sum(jnp.sum(jnp.square(x.astype(jnp.float32)))
+                        for x in leaves))
+
+
+def nonfinite_count(tree) -> jnp.ndarray:
+    """Number of non-finite elements across a pytree (f32 scalar)."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    if not leaves:
+        return jnp.float32(0.0)
+    return sum(jnp.sum(~jnp.isfinite(x.astype(jnp.float32)))
+               for x in leaves).astype(jnp.float32)
+
+
+def step_telemetry(old_params, new_params, grads, loss,
+                   losses: Dict[str, jnp.ndarray]) -> Dict:
+    """The in-step health pytree (all scalars; see module docstring).
+    ``losses`` is the step's aux loss-component dict."""
+    grad_norm = global_norm(grads)
+    param_norm = global_norm(old_params)
+    update_norm = global_norm(jax.tree_util.tree_map(
+        lambda a, b: a - b, new_params, old_params))
+    nonfinite = {k: (~jnp.isfinite(v)).astype(jnp.float32)
+                 for k, v in losses.items()}
+    nonfinite["total"] = (~jnp.isfinite(loss)).astype(jnp.float32)
+    nonfinite["grads"] = nonfinite_count(grads)
+    return {
+        "grad_norm": grad_norm,
+        "param_norm": param_norm,
+        "update_norm": update_norm,
+        "update_ratio": update_norm / jnp.maximum(param_norm, 1e-12),
+        "nonfinite": nonfinite,
+    }

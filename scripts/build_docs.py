@@ -38,8 +38,8 @@ DOCS = REPO / "docs"
 ORDER = ["index", "quick-start", "architecture", "models", "kernel-paths",
          "planner", "rollback", "ingest", "scaling", "configuration",
          "serving", "model-lifecycle", "compile-cache", "operations",
-         "device-efficiency", "flight-recorder", "quality", "chaos",
-         "static-analysis", "benchmarks"]
+         "device-efficiency", "flight-recorder", "quality",
+         "training-health", "chaos", "static-analysis", "benchmarks"]
 
 _CSS = """
 :root { --fg:#1a1f24; --bg:#ffffff; --accent:#0b63c5; --muted:#5a6572;

@@ -87,6 +87,16 @@ timeout 560 env JAX_PLATFORMS=cpu python benchmarks/run_quality_bench.py \
     --smoke > "$WORK/quality_smoke.json"
 echo "e2e: quality drift-injection smoke gates pass"
 
+# pre-flight: trainwatch smoke — the training-health plane end to end on
+# the real train loop: clean legs bit-identical loss history with zero
+# bundles and a cache-deserialized step (zero recompiles), the injected
+# nonfinite step fires exactly one doctor-readable train_divergence
+# bundle and flips /readyz to 503 (docs/training-health.md).  Pinned to
+# CPU: proves the divergence edge before any chip training relies on it.
+timeout 560 env JAX_PLATFORMS=cpu python benchmarks/run_train_health_bench.py \
+    --smoke > "$WORK/train_health_smoke.json"
+echo "e2e: trainwatch divergence smoke gates pass"
+
 # pre-flight: devtime smoke — the device-efficiency cost table (analytic
 # FLOPs / byte floor / roofline intensity for the serve ladder + flat
 # train step) resolves on CPU with every chip-relative column null

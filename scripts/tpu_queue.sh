@@ -63,6 +63,18 @@ then
   exit 1
 fi
 log "pre-flight: quality drift-injection gates pass"
+# pre-flight: trainwatch smoke on CPU — a tiny train run with the
+# health plane armed: clean legs bit-identical with zero bundles and a
+# cache-deserialized step, the injected nonfinite step fires exactly one
+# doctor-readable train_divergence bundle (docs/training-health.md);
+# proves the divergence edge BEFORE hours of chip training rely on it
+if ! timeout 560 env JAX_PLATFORMS=cpu python benchmarks/run_train_health_bench.py \
+  --smoke > /tmp/train_health_smoke.json 2>> /tmp/tpu_queue.log
+then
+  log "PRE-FLIGHT FAIL: trainwatch divergence gates (/tmp/train_health_smoke.json)"
+  exit 1
+fi
+log "pre-flight: trainwatch divergence gates pass"
 # pre-flight: devtime cost table on CPU — the analytic cost model must
 # resolve for the whole serve ladder + train step with every
 # chip-relative column null (docs/device-efficiency.md); fails in

@@ -48,6 +48,16 @@ then
   exit 1
 fi
 log "pre-flight: quality drift-injection gates pass"
+# same trainwatch pre-flight as tpu_queue.sh: the injected-divergence
+# gates proven on CPU before chip training relies on the divergence
+# edge (docs/training-health.md)
+if ! timeout 560 env JAX_PLATFORMS=cpu python benchmarks/run_train_health_bench.py \
+  --smoke > /tmp/train_health_smoke.json 2>> /tmp/tpu_queue.log
+then
+  log "PRE-FLIGHT FAIL: trainwatch divergence gates (/tmp/train_health_smoke.json)"
+  exit 1
+fi
+log "pre-flight: trainwatch divergence gates pass"
 # same devtime pre-flight as tpu_queue.sh: the cost table must resolve
 # on CPU with chip-relative columns null (docs/device-efficiency.md)
 if ! timeout 300 env JAX_PLATFORMS=cpu python -m nerrf_tpu.cli profile costs \

@@ -11,7 +11,8 @@ def test_all_pages_present_and_linked(repo_root):
             "models.md", "planner.md", "rollback.md", "scaling.md",
             "operations.md", "benchmarks.md", "configuration.md",
             "flight-recorder.md", "chaos.md",
-            "device-efficiency.md", "quality.md"} <= pages
+            "device-efficiency.md", "quality.md",
+            "training-health.md"} <= pages
     # every relative .md link in every page resolves
     for p in docs.glob("*.md"):
         for target in re.findall(r"\]\(([\w\-]+\.md)\)", p.read_text()):
