@@ -588,6 +588,18 @@ def main() -> None:
                     "warmup_speedup"),
                 "compile_warm_all_cache": (r.get("compile") or {}).get(
                     "warm_all_cache"),
+                # telemetry-archive plane: the serve bench's archive leg
+                # verdicts (docs/archive.md)
+                "archive_zero_record_loss": (r.get("archive") or {}).get(
+                    "zero_record_loss"),
+                "archive_p99_within_noise_band": (
+                    r.get("archive") or {}).get("p99_within_noise_band"),
+                "archive_report_offline_ok": (r.get("archive") or {}).get(
+                    "report_offline_ok"),
+                "archive_tune_validated": ((r.get("archive") or {}).get(
+                    "tune_export") or {}).get("validated_against_live"),
+                "archive_disk_bounded": ((r.get("archive") or {}).get(
+                    "rotation") or {}).get("disk_bounded"),
                 "backend": r.get("backend"),
                 "smoke": r.get("smoke"),
                 "provenance": r.get("provenance"),
@@ -817,6 +829,27 @@ def main() -> None:
             "serve_warm_all_cache":
                 (artifacts.get("serve") or {}).get("compile_warm_all_cache"),
         } if compile_seconds or artifacts.get("serve") else None,
+        # telemetry archive (nerrf_tpu/archive): the serve smoke's
+        # archive-leg verdicts — armed archiving must ride the noise
+        # band, lose zero journal records, agree with its own offline
+        # report/tune export, and hold the disk bound under rotation
+        "archive": {
+            "zero_record_loss":
+                (artifacts.get("serve") or {}).get(
+                    "archive_zero_record_loss"),
+            "p99_within_noise_band":
+                (artifacts.get("serve") or {}).get(
+                    "archive_p99_within_noise_band"),
+            "report_offline_ok":
+                (artifacts.get("serve") or {}).get(
+                    "archive_report_offline_ok"),
+            "tune_export_validated":
+                (artifacts.get("serve") or {}).get(
+                    "archive_tune_validated"),
+            "disk_bounded":
+                (artifacts.get("serve") or {}).get(
+                    "archive_disk_bounded"),
+        } if artifacts.get("serve") else None,
         # device truth (nerrf_tpu/devtime): per-program analytic-vs-
         # cost_analysis FLOPs and the serve path's per-bucket MFU — null
         # on CPU rigs by contract (a fabricated MFU is the failure mode

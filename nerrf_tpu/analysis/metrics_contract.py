@@ -104,6 +104,14 @@ REQUIRED = (
     "train_nonfinite_total",
     "train_throughput_steps",
     "train_data_starved_fraction",
+    # the telemetry archive plane (docs/archive.md; the retention runbook
+    # and run_serve_bench's archive leg key off these exact names — the
+    # writer is fail-open, so these counters are the only place a wedged
+    # disk or a backlogged writer is visible)
+    "archive_records_total",
+    "archive_bytes_total",
+    "archive_dropped_total",
+    "archive_writer_lag_seconds",
 )
 
 _CALL = re.compile(

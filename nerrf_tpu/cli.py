@@ -100,7 +100,9 @@ def cmd_train_detector(args) -> int:
         learning_rate=3e-3, warmup_steps=min(30, args.steps // 5),
         # arming the health plane turns the in-step telemetry on with it:
         # divergence detection without grad/update norms is loss-only
-        telemetry=(args.metrics_port >= 0 or bool(args.flight_dir)))
+        # (an armed archive wants the same records durable)
+        telemetry=(args.metrics_port >= 0 or bool(args.flight_dir)
+                   or bool(args.archive_dir)))
     compile_cache = None
     if not args.no_aot_cache:
         # persistent AOT cache (docs/compile-cache.md): a repeat run on an
@@ -115,7 +117,8 @@ def cmd_train_detector(args) -> int:
     from nerrf_tpu.trainwatch import training_health
 
     with training_health(metrics_port=args.metrics_port,
-                         flight_dir=args.flight_dir, log=_log) as monitor:
+                         flight_dir=args.flight_dir,
+                         archive_dir=args.archive_dir, log=_log) as monitor:
         if args.ckpt_every > 0:
             from nerrf_tpu.train.elastic import train_elastic
 
@@ -1088,6 +1091,20 @@ def cmd_serve_detect(args) -> int:
         # checkpoint-dir boot: bind the shipped drift baseline (registry
         # boots get theirs through manager.attach below, version-stamped)
         service.set_quality_profile(quality_profile)
+    archive = None
+    if args.archive_dir:
+        # telemetry archive plane (docs/archive.md): every journal
+        # record, cadenced metrics snapshots and the workload sketches
+        # spool continuously to crash-safe segments — `nerrf report`
+        # reconstructs SLO/capacity/drift/efficiency offline, and `nerrf
+        # archive export --tune` emits the cost-model corpus.  Wired
+        # BEFORE the recorder so bundles carry the archive position.
+        from nerrf_tpu.archive import ArchiveConfig, ArchiveWriter
+
+        archive = ArchiveWriter(ArchiveConfig(out_dir=args.archive_dir),
+                                log=_log)
+        service.attach_archive(archive)
+        _log(f"telemetry archive spooling to {args.archive_dir}")
     recorder = None
     uninstall_crash = None
     if args.flight_dir:
@@ -1108,7 +1125,7 @@ def cmd_serve_detect(args) -> int:
                          p99_breach_sec=args.deadline_sec,
                          profile_on_p99_sec=args.profile_on_breach_sec),
             info=service.flight_info, slo=service.slo,
-            quality=service.quality_snapshot, log=_log)
+            quality=service.quality_snapshot, archive=archive, log=_log)
         service.attach_flight(recorder)
         uninstall_crash = install_crash_handlers(recorder)
         _log(f"flight recorder armed: bundles in {args.flight_dir}"
@@ -1234,6 +1251,11 @@ def cmd_serve_detect(args) -> int:
             metrics.close()
         if recorder is not None:
             recorder.close()
+        if archive is not None:
+            # after the recorder: a crash bundle dumped during teardown
+            # still stamps a live archive position; close() drains the
+            # backlog and seals the tail segment
+            archive.close()
         if uninstall_crash is not None:
             uninstall_crash()
 
@@ -1308,15 +1330,110 @@ def cmd_ingest(args) -> int:
     return 0
 
 
+def cmd_archive(args) -> int:
+    """Telemetry archive maintenance: segment inventory, retention prune,
+    integrity verify, cross-host merge, and the tune-corpus export
+    (docs/archive.md).  All offline — no backend, no live process."""
+    from nerrf_tpu.archive import (
+        export_tune,
+        list_segments,
+        merge_archives,
+        verify_archive,
+    )
+    from nerrf_tpu.flight.journal import SchemaVersionError
+
+    try:
+        if args.archive_cmd == "ls":
+            names = list_segments(args.dir)
+            total = 0
+            for name in names:
+                p = Path(args.dir) / name
+                size = p.stat().st_size if p.exists() else 0
+                total += size
+                state = "open" if name.endswith(".open") else "sealed"
+                print(f"{name:<44} {size:>10}  {state}")
+            print(f"{len(names)} segment(s), {total} bytes")
+            return 0
+        if args.archive_cmd == "prune":
+            # out-of-band retention: sealed segments only — the dir may
+            # belong to a LIVE writer whose .open tail must stay its own
+            from nerrf_tpu.archive import prune_archive
+
+            if not Path(args.dir).is_dir():
+                raise FileNotFoundError(args.dir)
+            print(json.dumps(prune_archive(args.dir, args.max_bytes)))
+            return 0
+        if args.archive_cmd == "verify":
+            v = verify_archive(args.dir)
+            if args.json:
+                print(json.dumps(v, indent=2))
+            else:
+                for s in v["segments"]:
+                    flags = []
+                    if s["partial_tail"]:
+                        flags.append("partial-tail")
+                    if s["corrupt_lines"]:
+                        flags.append(f"{s['corrupt_lines']} corrupt")
+                    if s["error"]:
+                        flags.append(s["error"])
+                    print(f"{s['segment']:<44} {s['records']:>7} records  "
+                          + (" ".join(flags) or "ok"))
+                print(f"{'OK' if v['ok'] else 'DAMAGED'}: {v['records']} "
+                      f"records / {v['bytes']} bytes in "
+                      f"{len(v['segments'])} segment(s)")
+            return 0 if v["ok"] else 1
+        if args.archive_cmd == "merge":
+            out = merge_archives(args.sources, args.out, log=_log)
+            print(json.dumps(out))
+            return 0
+        if args.archive_cmd == "export":
+            corpus = export_tune(args.dir)
+            text = json.dumps(corpus, indent=2)
+            if args.out:
+                Path(args.out).write_text(text + "\n")
+                _log(f"tune corpus written to {args.out} "
+                     f"({corpus['windows_observed']} windows observed)")
+            else:
+                print(text)
+            return 0 if corpus["windows_observed"] else 1
+    except SchemaVersionError as e:
+        _log(f"cannot read archive: {e}")
+        return 2
+    except FileNotFoundError as e:
+        _log(f"not an archive directory: {e}")
+        return 2
+    return 2
+
+
+def cmd_report(args) -> int:
+    """Offline fleet report over archived telemetry (docs/archive.md):
+    SLO conformance, capacity headroom, drift, device efficiency and
+    training health from segments alone — or, with --compare, a
+    cross-run regression diff that exits 1 when the candidate regressed."""
+    from nerrf_tpu.archive import report_main
+
+    return report_main(args.dir, since=args.since, until=args.until,
+                       compare=args.compare, as_json=args.json)
+
+
 def cmd_doctor(args) -> int:
     """Two doctors behind one verb.  With a BUNDLE argument: the incident
     doctor — reconstruct a flight-recorder bundle's timeline + per-stage
     attribution offline, no live process needed (docs/flight-recorder.md).
-    Without: the environment doctor (scripts/check_env.py): python deps,
-    bounded backend probe, toolchain, native libs, capture, sandbox."""
+    A telemetry ARCHIVE directory renders the offline fleet report
+    instead (docs/archive.md).  Without an argument: the environment
+    doctor (scripts/check_env.py): python deps, bounded backend probe,
+    toolchain, native libs, capture, sandbox."""
     if args.bundle:
+        from nerrf_tpu.archive import is_archive_dir
         from nerrf_tpu.flight.doctor import doctor_main
 
+        if (not Path(args.bundle, "manifest.json").is_file()
+                and is_archive_dir(args.bundle)):
+            # an archive dir, not a bundle: same verb, the report reader
+            from nerrf_tpu.archive import report_main
+
+            return report_main([args.bundle], as_json=args.json)
         return doctor_main(args.bundle, tail=args.tail, as_json=args.json)
     import runpy
     import sys as _sys
@@ -1385,6 +1502,11 @@ def main(argv=None) -> int:
                    help="arm the training flight recorder: divergence/"
                         "starvation/stall bundles land here, readable "
                         "offline with `nerrf doctor <bundle>`")
+    p.add_argument("--archive-dir", default=None, metavar="DIR",
+                   help="spool the run's telemetry (journal, metrics "
+                        "snapshots, step sketches) into a crash-safe "
+                        "segmented archive `nerrf report` reads offline "
+                        "(docs/archive.md)")
     p.set_defaults(fn=cmd_train_detector)
 
     p = sub.add_parser("models", help="model lifecycle registry: publish, "
@@ -1547,6 +1669,14 @@ def main(argv=None) -> int:
                         "excepthook+faulthandler) dump self-contained "
                         "diagnostic bundles here, readable offline with "
                         "`nerrf doctor <bundle>`")
+    p.add_argument("--archive-dir", default=None, metavar="DIR",
+                   help="spool the service's telemetry continuously into "
+                        "a crash-safe segmented archive here (journal "
+                        "records, cadenced metrics snapshots, workload "
+                        "sketches) — `nerrf report` reconstructs SLO/"
+                        "capacity/drift/efficiency offline, and `nerrf "
+                        "archive export --tune` emits the cost-model "
+                        "corpus (docs/archive.md)")
     p.add_argument("--aot-cache", default=None, metavar="DIR",
                    help="persistent compile cache root (default: "
                         "$NERRF_AOT_CACHE_DIR or ~/.cache/nerrf_tpu/aot) — "
@@ -1747,8 +1877,79 @@ def main(argv=None) -> int:
                    help="suppression file (default: .nerrflint-baseline)")
     p.set_defaults(fn=cmd_lint)
 
-    p = sub.add_parser("doctor", help="diagnose the environment, or read a "
-                                      "flight-recorder incident bundle")
+    p = sub.add_parser("archive", help="telemetry archive: segment "
+                                       "inventory, retention prune, "
+                                       "integrity verify, cross-host "
+                                       "merge, tune-corpus export "
+                                       "(docs/archive.md)")
+    asub = p.add_subparsers(dest="archive_cmd", required=True)
+    ar = asub.add_parser("ls", help="segment inventory (name, bytes, "
+                                    "sealed/open), oldest first")
+    ar.add_argument("dir", help="archive directory (a serve/train run's "
+                                "--archive-dir)")
+    ar.set_defaults(fn=cmd_archive)
+    ar = asub.add_parser("prune", help="enforce a retention bound now: "
+                                       "delete oldest sealed segments "
+                                       "past --max-bytes")
+    ar.add_argument("dir")
+    ar.add_argument("--max-bytes", type=int, required=True,
+                    help="total archive size to prune down to")
+    ar.set_defaults(fn=cmd_archive)
+    ar = asub.add_parser("verify", help="integrity check every segment "
+                                        "(a torn final line is the "
+                                        "tolerated crash shape; mid-"
+                                        "segment damage exits 1)")
+    ar.add_argument("dir")
+    ar.add_argument("--json", action="store_true")
+    ar.set_defaults(fn=cmd_archive)
+    ar = asub.add_parser("merge", help="merge N archive directories into "
+                                       "a fresh one (cross-host "
+                                       "aggregation: records interleave "
+                                       "by time, sketches stay "
+                                       "attributable per run)")
+    ar.add_argument("sources", nargs="+", help="archive directories to "
+                                               "merge")
+    ar.add_argument("--out", required=True, help="merged archive "
+                                                 "directory (created)")
+    ar.set_defaults(fn=cmd_archive)
+    ar = asub.add_parser("export", help="emit the tune-ready corpus: the "
+                                        "observed window-size "
+                                        "distribution + per-bucket "
+                                        "measured cost table the `nerrf "
+                                        "tune` cost-model fit consumes")
+    ar.add_argument("dir")
+    ar.add_argument("--tune", action="store_true",
+                    help="the cost-model corpus (the only export today; "
+                         "the flag names the schema)")
+    ar.add_argument("--out", default=None, metavar="FILE",
+                    help="write the corpus JSON here instead of stdout")
+    ar.set_defaults(fn=cmd_archive)
+
+    p = sub.add_parser("report", help="offline fleet report over archived "
+                                      "telemetry: SLO/capacity/drift/"
+                                      "efficiency/train health from "
+                                      "segments alone; --compare diffs "
+                                      "two runs (docs/archive.md)")
+    p.add_argument("dir", nargs="*", default=[],
+                   help="archive director(ies) — multiple dirs merge "
+                        "into one report")
+    p.add_argument("--compare", nargs=2, default=None,
+                   metavar=("BASELINE", "CANDIDATE"),
+                   help="diff two archive dirs and exit 1 when the "
+                        "candidate regressed (p99, breach/drop rate, "
+                        "per-bucket device cost, drift, train loss)")
+    p.add_argument("--since", type=float, default=None, metavar="UNIX",
+                   help="only records at/after this unix timestamp")
+    p.add_argument("--until", type=float, default=None, metavar="UNIX",
+                   help="only records at/before this unix timestamp")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable report")
+    p.set_defaults(fn=cmd_report)
+
+    p = sub.add_parser("doctor", help="diagnose the environment, read a "
+                                      "flight-recorder incident bundle, "
+                                      "or report over a telemetry "
+                                      "archive directory")
     p.add_argument("bundle", nargs="?", default=None,
                    help="flight bundle directory (bundle-<utc>-<trigger>): "
                         "print the incident timeline + per-stage "

@@ -156,6 +156,15 @@ def format_report(bundle: dict, tail: Optional[int] = None) -> str:
     if lineage:
         lines.append("  model: " + " ".join(
             f"{k}={_compact(v)}" for k, v in sorted(lineage.items())))
+    arch = man.get("archive")
+    if arch:
+        seqs = arch.get("journal_seq") or {}
+        lines.append(
+            f"  archive context: {arch.get('dir')}/"
+            f"{arch.get('segment') or '(no segment yet)'}"
+            + (f" seq {seqs.get('lo')}..{seqs.get('hi')}" if seqs else "")
+            + " — `nerrf report <dir>` reads the whole run around "
+              "this bundle")
     if bundle["missing"]:
         lines.append("  MISSING from bundle: "
                      + ", ".join(bundle["missing"]))
@@ -357,10 +366,17 @@ def _num(v) -> str:
 def doctor_main(path, tail: Optional[int] = None, as_json: bool = False,
                 out=print) -> int:
     """The `nerrf doctor <bundle>` body; returns a CLI exit code."""
+    from nerrf_tpu.flight.journal import SchemaVersionError
+
     try:
         bundle = read_bundle(path)
     except FileNotFoundError as e:
         out(str(e))
+        return 2
+    except SchemaVersionError as e:
+        # a bundle written by a NEWER major journal schema: refuse with
+        # one line rather than render re-defined fields wrong
+        out(f"cannot read bundle {path}: {e}")
         return 2
     except (OSError, ValueError) as e:
         out(f"cannot read bundle {path}: {e}")

@@ -61,6 +61,61 @@ import time
 from collections import deque
 from typing import Callable, Dict, List, Optional
 
+# Journal jsonl schema version, stamped on every serialized record as
+# ``"v": "<major>.<minor>"``.  Archived segments and flight bundles
+# outlive the process that wrote them, so readers apply the usual
+# compatibility ladder: a MINOR bump adds fields (old readers ignore
+# them, new readers tolerate their absence); a MAJOR bump changes the
+# meaning of existing fields, and an older reader must refuse rather
+# than misreport evidence.  Bump the minor when adding record fields,
+# the major only when a field's meaning changes.
+SCHEMA_VERSION = (1, 0)
+
+#: Every record kind a journal producer emits today (producers in the
+#: module docstring above, plus compile/profile/capacity records from
+#: compilecache/, devtime/ and the archive plane).  The schema roundtrip
+#: test iterates this tuple — a new kind that is not registered here is
+#: a kind the archive/doctor readers have never been proven against.
+KNOWN_KINDS = (
+    "batch_close", "batch_failed", "batch_bisect", "device_batch_failed",
+    "stream_quarantined", "stream_released", "scorer_wedged",
+    "scorer_recovered", "reconnect", "admission_drop", "demux_drop",
+    "readiness", "config", "slo_breach", "fault_injected", "chaos_armed",
+    "chaos_disarmed", "registry_publish", "registry_shadow",
+    "registry_promote", "registry_veto", "registry_swap",
+    "registry_shadow_stats", "quality_reference", "quality_stats",
+    "capacity_saturation", "compile", "compile_cache_prune",
+    "profile_capture", "profile_failed", "train_start", "train_done",
+    "train_health", "exception", "bundle",
+)
+
+
+class SchemaVersionError(ValueError):
+    """A serialized record's schema MAJOR is newer than this reader."""
+
+
+def _format_version(v: tuple) -> str:
+    return f"{v[0]}.{v[1]}"
+
+
+def check_schema_version(v, what: str = "journal record") -> None:
+    """Reader-side gate: tolerate same/older majors and newer minors
+    (additive fields), refuse a newer MAJOR with a one-line error —
+    misreading re-defined fields is worse than not reading at all.
+    ``None`` (a record written before versioning) passes."""
+    if v is None:
+        return
+    try:
+        major = int(str(v).split(".", 1)[0])
+    except (TypeError, ValueError):
+        raise SchemaVersionError(
+            f"{what} carries an unparseable schema version {v!r}") from None
+    if major > SCHEMA_VERSION[0]:
+        raise SchemaVersionError(
+            f"{what} schema v{v} is newer than this reader's "
+            f"v{_format_version(SCHEMA_VERSION)} — upgrade nerrf_tpu to "
+            f"read it")
+
 
 def make_trace_id(stream: str, window_idx: int, lo_ns: int) -> str:
     """Deterministic per-window trace ID: the same (stream, window, epoch)
@@ -92,7 +147,8 @@ class JournalRecord:
     data: Dict = dataclasses.field(default_factory=dict)
 
     def to_dict(self) -> dict:
-        d = {"seq": self.seq, "t_wall": self.t_wall, "t_perf": self.t_perf,
+        d = {"v": _format_version(SCHEMA_VERSION), "seq": self.seq,
+             "t_wall": self.t_wall, "t_perf": self.t_perf,
              "kind": self.kind}
         if self.stream is not None:
             d["stream"] = self.stream
@@ -106,6 +162,7 @@ class JournalRecord:
 
     @classmethod
     def from_dict(cls, d: dict) -> "JournalRecord":
+        check_schema_version(d.get("v"))
         return cls(seq=int(d["seq"]), t_wall=float(d["t_wall"]),
                    t_perf=float(d.get("t_perf", 0.0)), kind=str(d["kind"]),
                    stream=d.get("stream"), window_id=d.get("window_id"),
@@ -203,7 +260,9 @@ class EventJournal:
 def load_journal(path) -> List[JournalRecord]:
     """Parse a journal.jsonl back into records (the doctor's reader).
     Malformed lines are skipped, not fatal — a bundle written mid-crash is
-    still evidence."""
+    still evidence.  A NEWER-MAJOR schema stamp is NOT malformed: it
+    propagates (`SchemaVersionError`) so the doctor/report can refuse
+    with one line instead of silently misreading re-defined fields."""
     out: List[JournalRecord] = []
     with open(os.fspath(path)) as f:
         for line in f:
@@ -212,6 +271,8 @@ def load_journal(path) -> List[JournalRecord]:
                 continue
             try:
                 out.append(JournalRecord.from_dict(json.loads(line)))
+            except SchemaVersionError:
+                raise
             except (ValueError, KeyError, TypeError):
                 continue
     return out

@@ -125,7 +125,7 @@ class FlightRecorder:
 
     def __init__(self, cfg: FlightConfig, registry=None, journal=None,
                  tracer=None, slo=None, info=None, quality=None,
-                 log=None) -> None:
+                 archive=None, log=None) -> None:
         if registry is None:
             from nerrf_tpu.observability import DEFAULT_REGISTRY
 
@@ -147,6 +147,12 @@ class FlightRecorder:
         # when it returns one, so a drift bundle is self-contained and
         # ANY bundle can answer "was the model drifting at the time"
         self._quality = quality
+        # archive: the telemetry ArchiveWriter (or any position()-bearing
+        # object).  Every bundle's manifest then carries the active
+        # archive segment + journal seq range AT DUMP TIME, so `nerrf
+        # doctor` can point from a bundle (one ring's worth of tail) to
+        # the surrounding archived context (the whole run)
+        self._archive = archive
         self._quality_streak = 0
         self._log = log or (lambda msg: None)
         self._lock = threading.Lock()
@@ -386,6 +392,8 @@ class FlightRecorder:
                        else None,
                 "profile": profile,
                 "quality": "quality.json" if quality else None,
+                "archive": (_safe(self._archive.position)
+                            if self._archive is not None else None),
                 "lineage": _safe(self._info),
                 "env": env_fingerprint(),
             }

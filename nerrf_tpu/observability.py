@@ -173,6 +173,38 @@ class MetricsRegistry:
                     removed = True
         return removed
 
+    def snapshot(self) -> dict:
+        """JSON-serializable copy of every live series — the telemetry
+        archive's cadenced `metrics_snapshot` record (and any other
+        offline consumer that wants values, not text exposition).  Same
+        two-phase discipline as `render`: copy under the lock, shape the
+        output outside it.  Label keys are rendered as the sorted
+        ``k=v,k=v`` string ("" for the unlabeled series) so the snapshot
+        roundtrips through JSON without tuple keys."""
+        with self._lock:
+            counters = {n: dict(s) for n, s in self._counters.items()}
+            gauges = {n: dict(s) for n, s in self._gauges.items()}
+            hists = {n: {k: list(cell) for k, cell in s.items()}
+                     for n, s in self._hists.items()}
+            hist_buckets = dict(self._hist_buckets)
+
+        def key(k: _LabelKey) -> str:
+            return ",".join(f"{a}={b}" for a, b in k)
+
+        out = {"counters": {}, "gauges": {}, "histograms": {}}
+        for n, s in sorted(counters.items()):
+            out["counters"][n] = {key(k): v for k, v in sorted(s.items())}
+        for n, s in sorted(gauges.items()):
+            out["gauges"][n] = {key(k): v for k, v in sorted(s.items())}
+        for n, s in sorted(hists.items()):
+            bk = hist_buckets.get(n, ())
+            out["histograms"][n] = {
+                "buckets": list(bk),
+                "series": {key(k): {"cum": cell[:len(bk) + 1],
+                                    "sum": cell[-2], "count": cell[-1]}
+                           for k, cell in sorted(s.items())}}
+        return out
+
     def render(self) -> str:
         """Prometheus text exposition format, one block per metric.
 

@@ -194,6 +194,12 @@ class OnlineDetectionService:
                          else (QualityMonitor(registry=registry,
                                               journal=self._journal)
                                if self.cfg.quality_monitoring else None))
+        # telemetry archive plane (nerrf_tpu/archive): when attached, the
+        # demux boundary feeds each scored window's measured structure +
+        # stage stamps into the writer's workload sketches (journal
+        # records reach it through its own subscription).  One None check
+        # per window when absent
+        self._archive = None
         # the background cost-registration thread (start()) + its stop
         # flag: stop() must be able to wait it out — a daemon thread
         # still inside jax tracing when the interpreter tears down is a
@@ -329,6 +335,12 @@ class OnlineDetectionService:
         p99-breach trigger (journal-record triggers need no binding — the
         recorder subscribes to the journal itself)."""
         self._flight = recorder
+
+    def attach_archive(self, writer) -> None:
+        """Bind a telemetry ArchiveWriter: scored windows feed its
+        workload sketches at the demux boundary (journal records reach it
+        through its own subscription — docs/archive.md)."""
+        self._archive = writer
 
     @property
     def slo(self) -> SLOTracker:
@@ -912,20 +924,31 @@ class OnlineDetectionService:
             # admit → packed (queue) → scorer pickup (pack) → scored
             # (device) → here (demux); e2e runs admit → demux
             e2e = t_demux - s.t_admit
+            stages = {"queue": s.t_packed - s.t_admit,
+                      "pack": s.t_device - s.t_packed,
+                      "device": s.t_scored - s.t_device,
+                      "demux": t_demux - s.t_scored}
             self._slo.observe(
                 s.stream, s.trace_id, s.window_idx,
-                stages={"queue": s.t_packed - s.t_admit,
-                        "pack": s.t_device - s.t_packed,
-                        "device": s.t_scored - s.t_device,
-                        "demux": t_demux - s.t_scored},
-                e2e_sec=e2e)
+                stages=stages, e2e_sec=e2e)
             if self._flight is not None:
                 self._flight.observe_window(s.stream, s.trace_id, e2e)
             # alerting: hot windows only, never blocking (bounded sink).
-            # Fail-open per window: a raising sink/quality observer must
-            # lose at most this window's alert, never the ledger
-            # resolution below — an unresolved window wedges leave()
+            # Fail-open per window: a raising sink/quality/archive
+            # observer must lose at most this window's alert, never the
+            # ledger resolution below — an unresolved window wedges
+            # leave()
             try:
+                if self._archive is not None:
+                    # workload sketches for the durable archive: the
+                    # window's admission-measured structure + the same
+                    # stage stamps the SLO plane just consumed (O(bins)
+                    # per window, no IO — the writer thread owns the
+                    # disk)
+                    self._archive.observe_window(
+                        bucket_tag(s.bucket), nodes=s.nodes,
+                        edges=s.edges, files=s.files, stages=stages,
+                        e2e_sec=e2e)
                 mask = s.node_mask.astype(bool)
                 hot_slots = (np.nonzero(mask & (s.probs >= alert_thr))[0]
                              if mask.any() else np.empty(0, np.int64))

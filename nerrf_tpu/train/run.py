@@ -38,17 +38,20 @@ def run_experiment(name_or_path: str, out_dir: str | Path,
                    lineage: str = "default",
                    compile_cache=None,
                    metrics_port: int = -1,
-                   flight_dir: str | None = None) -> dict:
+                   flight_dir: str | None = None,
+                   archive_dir: str | None = None) -> dict:
     """``metrics_port`` ≥ 0 / ``flight_dir`` arm the training-health plane
     (docs/training-health.md): a /metrics+/readyz endpoint with the
     train-aware ready check (503 before the first step and on a
     divergence halt) and train-side flight triggers dumping
-    doctor-readable bundles.  Both off (the defaults) costs the loop
-    nothing."""
+    doctor-readable bundles.  ``archive_dir`` spools the run's journal +
+    metrics snapshots + step-cadence sketches to a crash-safe telemetry
+    archive `nerrf report` reads offline (docs/archive.md).  All off
+    (the defaults) costs the loop nothing."""
     from nerrf_tpu.trainwatch import training_health
 
     with training_health(metrics_port=metrics_port, flight_dir=flight_dir,
-                         log=_log) as monitor:
+                         archive_dir=archive_dir, log=_log) as monitor:
         return _run_experiment(name_or_path, out_dir, num_steps, ckpt_every,
                                sharded, calibrate, publish_to, lineage,
                                compile_cache, monitor)
@@ -368,6 +371,12 @@ def main(argv=None) -> int:
                          "bundles here (loss/grad history tail, run "
                          "fingerprints, last-good checkpoint pointer), "
                          "readable offline with `nerrf doctor <bundle>`")
+    ap.add_argument("--archive-dir", default=None, metavar="DIR",
+                    help="spool the run's telemetry (journal records, "
+                         "cadenced metrics snapshots, step-cadence "
+                         "workload sketches) into a crash-safe segmented "
+                         "archive here — `nerrf report` reconstructs the "
+                         "run's health offline (docs/archive.md)")
     args = ap.parse_args(argv)
     # Multi-host: join the cluster BEFORE any backend use.  Set
     # NERRF_COORDINATOR/NERRF_NUM_PROCESSES/NERRF_PROCESS_ID per process
@@ -420,7 +429,8 @@ def main(argv=None) -> int:
                             lineage=args.lineage,
                             compile_cache=compile_cache,
                             metrics_port=args.metrics_port,
-                            flight_dir=args.flight_dir)
+                            flight_dir=args.flight_dir,
+                            archive_dir=args.archive_dir)
     return 0 if all(report["gates"].values()) else 1
 
 

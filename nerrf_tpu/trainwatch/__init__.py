@@ -30,36 +30,54 @@ from nerrf_tpu.trainwatch.telemetry import (  # noqa: F401
 
 
 @contextlib.contextmanager
-def training_health(metrics_port=None, flight_dir=None,
+def training_health(metrics_port=None, flight_dir=None, archive_dir=None,
                     cfg=None, registry=None, journal=None, log=None):
     """Wire the training-health plane for one run; yields the monitor
-    (None when both surfaces are disabled — the loop then pays nothing).
+    (None when every surface is disabled — the loop then pays nothing).
 
     * ``metrics_port`` ≥ 0 → a `MetricsServer` with the train-aware
       ``ready_check`` (503 before the first step and after a
       divergence halt);
     * ``flight_dir`` set → a `FlightRecorder` whose ``info()`` is the
-      monitor's run identity; train triggers dump bundles there.
+      monitor's run identity; train triggers dump bundles there;
+    * ``archive_dir`` set → a telemetry `ArchiveWriter`
+      (docs/archive.md): the run's journal stream (train_start /
+      train_health / train_done, exceptions, compiles), cadenced
+      metrics snapshots and the train-step workload sketch spool to
+      crash-safe segments `nerrf report` reads offline.  Bundles dumped
+      by the recorder carry the archive position in their manifest.
 
     Teardown order matters and is owned here: monitor thread first (it
     may fire into the recorder), then the recorder's journal
-    subscription, then the HTTP server.
+    subscription, then the archive writer (it seals the tail), then the
+    HTTP server.
     """
-    if (metrics_port is None or metrics_port < 0) and not flight_dir:
+    if (metrics_port is None or metrics_port < 0) and not flight_dir \
+            and not archive_dir:
         yield None
         return
     monitor = TrainHealthMonitor(cfg, registry=registry, journal=journal,
                                  log=log)
     recorder = None
     server = None
+    archive = None
     try:
+        if archive_dir:
+            from nerrf_tpu.archive import ArchiveConfig, ArchiveWriter
+
+            archive = ArchiveWriter(ArchiveConfig(out_dir=str(archive_dir)),
+                                    registry=registry, journal=journal,
+                                    log=log)
+            if log:
+                log(f"trainwatch: telemetry archive spooling to "
+                    f"{archive_dir}")
         if flight_dir:
             from nerrf_tpu.flight import FlightConfig, FlightRecorder
 
             recorder = FlightRecorder(
                 FlightConfig(out_dir=str(flight_dir)),
                 registry=registry, journal=journal,
-                info=monitor.flight_info, log=log)
+                info=monitor.flight_info, archive=archive, log=log)
             monitor.attach_flight(recorder)
             if log:
                 log(f"trainwatch: flight recorder armed, bundles in "
@@ -79,5 +97,7 @@ def training_health(metrics_port=None, flight_dir=None,
         monitor.stop()
         if recorder is not None:
             recorder.close()
+        if archive is not None:
+            archive.close()
         if server is not None:
             server.close()

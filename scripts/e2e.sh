@@ -97,6 +97,32 @@ timeout 560 env JAX_PLATFORMS=cpu python benchmarks/run_train_health_bench.py \
     --smoke > "$WORK/train_health_smoke.json"
 echo "e2e: trainwatch divergence smoke gates pass"
 
+# pre-flight: archive smoke — the telemetry archive plane end to end on
+# the real serve path: a short serve run spools journal + metrics +
+# workload sketches into crash-safe segments, then `nerrf report` must
+# reconstruct the run (windows scored, e2e quantiles) from the segments
+# alone and `nerrf archive verify` must find them intact
+# (docs/archive.md).  Pinned to CPU: archiving is jax-free and must
+# work on a tunnel-wedged host.
+timeout 300 env JAX_PLATFORMS=cpu python -m nerrf_tpu.cli serve-detect \
+    --trace datasets/traces/toy_trace.csv --no-probe --metrics-port -1 \
+    --archive-dir "$WORK/archive" --buckets 256x512x128 --no-aot-cache \
+    > "$WORK/archive_serve.json" 2>> "$WORK/archive_serve.log"
+timeout 120 env JAX_PLATFORMS=cpu python -m nerrf_tpu.cli archive verify \
+    "$WORK/archive" > /dev/null
+timeout 120 env JAX_PLATFORMS=cpu python -m nerrf_tpu.cli report \
+    "$WORK/archive" --json > "$WORK/archive_report.json"
+python - "$WORK/archive_report.json" <<'EOF'
+import json, sys
+r = json.load(open(sys.argv[1]))
+assert r["span"]["records"] > 0, "archive spooled nothing"
+assert r["slo"]["windows_scored"] > 0, "no windows reached the sketches"
+assert (r["slo"]["e2e_ms"] or {}).get("p99") is not None, "no e2e sketch"
+print(f"e2e: archive report reconstructs the run offline "
+      f"({r['span']['records']} records, "
+      f"{r['slo']['windows_scored']} windows)")
+EOF
+
 # pre-flight: devtime smoke — the device-efficiency cost table (analytic
 # FLOPs / byte floor / roofline intensity for the serve ladder + flat
 # train step) resolves on CPU with every chip-relative column null

@@ -75,6 +75,32 @@ then
   exit 1
 fi
 log "pre-flight: trainwatch divergence gates pass"
+# pre-flight: archive smoke on CPU — a short serve run with the
+# telemetry archive armed, then `nerrf report` must reconstruct the run
+# (windows scored, e2e quantiles) from the segments alone and `archive
+# verify` must find them intact (docs/archive.md); runs BEFORE any
+# tunnel time
+rm -rf /tmp/archive_smoke
+if ! { timeout 300 env JAX_PLATFORMS=cpu python -m nerrf_tpu.cli serve-detect \
+    --trace datasets/traces/toy_trace.csv --no-probe --metrics-port -1 \
+    --archive-dir /tmp/archive_smoke --buckets 256x512x128 --no-aot-cache \
+    > /tmp/archive_serve.json 2>> /tmp/tpu_queue.log \
+  && timeout 120 env JAX_PLATFORMS=cpu python -m nerrf_tpu.cli archive verify \
+    /tmp/archive_smoke >> /tmp/tpu_queue.log 2>&1 \
+  && timeout 120 env JAX_PLATFORMS=cpu python -m nerrf_tpu.cli report \
+    /tmp/archive_smoke --json > /tmp/archive_report.json 2>> /tmp/tpu_queue.log \
+  && python -c "
+import json
+r = json.load(open('/tmp/archive_report.json'))
+assert r['span']['records'] > 0 and r['slo']['windows_scored'] > 0
+assert (r['slo']['e2e_ms'] or {}).get('p99') is not None
+" ; }
+then
+  log "PRE-FLIGHT FAIL: archive report gates (/tmp/archive_report.json)"
+  exit 1
+fi
+rm -rf /tmp/archive_smoke
+log "pre-flight: archive report reconstructs the run offline"
 # pre-flight: devtime cost table on CPU — the analytic cost model must
 # resolve for the whole serve ladder + train step with every
 # chip-relative column null (docs/device-efficiency.md); fails in

@@ -58,6 +58,29 @@ then
   exit 1
 fi
 log "pre-flight: trainwatch divergence gates pass"
+# same archive pre-flight as tpu_queue.sh: a short archived serve run,
+# then the offline report must reconstruct it from segments alone
+# (docs/archive.md)
+rm -rf /tmp/archive_smoke
+if ! { timeout 300 env JAX_PLATFORMS=cpu python -m nerrf_tpu.cli serve-detect \
+    --trace datasets/traces/toy_trace.csv --no-probe --metrics-port -1 \
+    --archive-dir /tmp/archive_smoke --buckets 256x512x128 --no-aot-cache \
+    > /tmp/archive_serve.json 2>> /tmp/tpu_queue.log \
+  && timeout 120 env JAX_PLATFORMS=cpu python -m nerrf_tpu.cli archive verify \
+    /tmp/archive_smoke >> /tmp/tpu_queue.log 2>&1 \
+  && timeout 120 env JAX_PLATFORMS=cpu python -m nerrf_tpu.cli report \
+    /tmp/archive_smoke --json > /tmp/archive_report.json 2>> /tmp/tpu_queue.log \
+  && python -c "
+import json
+r = json.load(open('/tmp/archive_report.json'))
+assert r['span']['records'] > 0 and r['slo']['windows_scored'] > 0
+" ; }
+then
+  log "PRE-FLIGHT FAIL: archive report gates (/tmp/archive_report.json)"
+  exit 1
+fi
+rm -rf /tmp/archive_smoke
+log "pre-flight: archive report reconstructs the run offline"
 # same devtime pre-flight as tpu_queue.sh: the cost table must resolve
 # on CPU with chip-relative columns null (docs/device-efficiency.md)
 if ! timeout 300 env JAX_PLATFORMS=cpu python -m nerrf_tpu.cli profile costs \

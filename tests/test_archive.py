@@ -1,0 +1,677 @@
+"""Telemetry archive plane: spool crash-safety, writer fan-in, offline
+reports, schema versioning, bundle pointers (docs/archive.md)."""
+
+import json
+import os
+import time
+
+import pytest
+
+from nerrf_tpu.archive import (
+    ArchiveConfig,
+    ArchiveSpool,
+    ArchiveWriter,
+    SpoolConfig,
+    build_report,
+    compare_reports,
+    export_tune,
+    format_compare,
+    format_report,
+    is_archive_dir,
+    iter_records,
+    list_segments,
+    merge_archives,
+    read_segment,
+    report_main,
+    verify_archive,
+)
+from nerrf_tpu.flight.journal import (
+    KNOWN_KINDS,
+    SCHEMA_VERSION,
+    EventJournal,
+    JournalRecord,
+    SchemaVersionError,
+    load_journal,
+)
+from nerrf_tpu.observability import MetricsRegistry
+
+
+def make_writer(tmp_path, registry=None, journal=None, **cfg):
+    registry = registry or MetricsRegistry(namespace="test")
+    journal = journal or EventJournal(registry=registry)
+    cfg.setdefault("snapshot_every_sec", 3600.0)  # cadence off by default
+    w = ArchiveWriter(ArchiveConfig(out_dir=str(tmp_path), **cfg),
+                      registry=registry, journal=journal)
+    return w, registry, journal
+
+
+def drain(writer, timeout=5.0):
+    """Wait for the writer thread to catch up (tests only)."""
+    deadline = time.monotonic() + timeout
+    while not writer._q.empty() and time.monotonic() < deadline:
+        time.sleep(0.01)
+    time.sleep(0.05)
+
+
+# -- spool --------------------------------------------------------------------
+
+
+class TestSpool:
+    def test_append_seal_roundtrip(self, tmp_path):
+        spool = ArchiveSpool(SpoolConfig(out_dir=str(tmp_path)),
+                             registry=MetricsRegistry(namespace="t"))
+        for i in range(5):
+            assert spool.append({"kind": "x", "i": i})
+        assert spool.active_segment is not None
+        spool.close()
+        segs = list_segments(tmp_path)
+        assert len(segs) == 1 and not segs[0].endswith(".open")
+        records, partial, corrupt = read_segment(tmp_path / segs[0])
+        assert [r["i"] for r in records] == list(range(5))
+        assert not partial and corrupt == 0
+
+    def test_rotation_by_bytes_names_sort_chronologically(self, tmp_path):
+        spool = ArchiveSpool(
+            SpoolConfig(out_dir=str(tmp_path), segment_max_bytes=200),
+            registry=MetricsRegistry(namespace="t"))
+        for i in range(50):
+            spool.append({"kind": "x", "i": i, "pad": "p" * 40})
+        spool.close()
+        segs = list_segments(tmp_path)
+        assert len(segs) > 3
+        assert segs == sorted(segs)
+        # order across segments is append order
+        seen = [r["i"] for r in iter_records(tmp_path)]
+        assert seen == list(range(50))
+
+    def test_rotation_by_age(self, tmp_path):
+        spool = ArchiveSpool(
+            SpoolConfig(out_dir=str(tmp_path), segment_max_age_sec=0.05),
+            registry=MetricsRegistry(namespace="t"))
+        spool.append({"kind": "x", "i": 0})
+        time.sleep(0.08)
+        spool.append({"kind": "x", "i": 1})  # rotation fires on this one
+        spool.close()
+        assert len(list_segments(tmp_path)) == 2
+
+    def test_retention_bound_enforced_oldest_first(self, tmp_path):
+        spool = ArchiveSpool(
+            SpoolConfig(out_dir=str(tmp_path), segment_max_bytes=300,
+                        max_total_bytes=1000),
+            registry=MetricsRegistry(namespace="t"))
+        for i in range(200):
+            spool.append({"kind": "x", "i": i, "pad": "p" * 60})
+        spool.close()
+        total = sum((tmp_path / s).stat().st_size
+                    for s in list_segments(tmp_path))
+        assert total <= 1000 + 300  # bound + one active segment's slack
+        assert spool.pruned > 0
+        # the SURVIVING records are the newest ones
+        seen = [r["i"] for r in iter_records(tmp_path)]
+        assert seen == list(range(min(seen), 200))
+
+    def test_crashed_open_segment_adopted_on_next_boot(self, tmp_path):
+        spool = ArchiveSpool(SpoolConfig(out_dir=str(tmp_path)),
+                             registry=MetricsRegistry(namespace="t"))
+        spool.append({"kind": "x", "i": 0})
+        # simulate kill -9: no close(), the .open tail stays behind
+        open_segs = [s for s in os.listdir(tmp_path) if s.endswith(".open")]
+        assert len(open_segs) == 1
+        spool2 = ArchiveSpool(SpoolConfig(out_dir=str(tmp_path)),
+                              registry=MetricsRegistry(namespace="t"))
+        assert not any(s.endswith(".open") for s in os.listdir(tmp_path))
+        spool2.append({"kind": "x", "i": 1})
+        spool2.close()
+        # nothing lost, numbering continued (no collision with the
+        # adopted segment)
+        assert [r["i"] for r in iter_records(tmp_path)] == [0, 1]
+        assert len(list_segments(tmp_path)) == 2
+
+    def test_partial_tail_tolerated_corruption_flagged(self, tmp_path):
+        spool = ArchiveSpool(SpoolConfig(out_dir=str(tmp_path)),
+                             registry=MetricsRegistry(namespace="t"))
+        for i in range(3):
+            spool.append({"kind": "x", "i": i})
+        spool.close()
+        seg = tmp_path / list_segments(tmp_path)[0]
+        # kill -9 mid-write: truncate inside the final record
+        raw = seg.read_bytes()
+        seg.write_bytes(raw[:-7])
+        records, partial, corrupt = read_segment(seg)
+        assert [r["i"] for r in records] == [0, 1] and partial
+        assert verify_archive(tmp_path)["ok"] is True  # the crash shape
+        # corruption in the MIDDLE is a different story
+        lines = raw.split(b"\n")
+        lines[1] = b'{"kind": "x", TORN'
+        seg.write_bytes(b"\n".join(lines))
+        v = verify_archive(tmp_path)
+        assert v["ok"] is False
+        assert v["segments"][0]["corrupt_lines"] == 1
+
+    def test_adopted_crash_segment_verifies_clean_forever(self, tmp_path):
+        """A crash tears the tail of ITS segment; adoption seals it and
+        later segments append after it.  verify must keep tolerating
+        that torn line even once the segment is no longer last — the
+        adopted evidence stays mid-directory for the rest of its life."""
+        spool = ArchiveSpool(SpoolConfig(out_dir=str(tmp_path)),
+                             registry=MetricsRegistry(namespace="t"))
+        for i in range(3):
+            spool.append({"kind": "x", "i": i})
+        # kill -9: torn final line, no close
+        open_seg = [s for s in os.listdir(tmp_path)
+                    if s.endswith(".open")][0]
+        p = tmp_path / open_seg
+        p.write_bytes(p.read_bytes()[:-5])
+        # restart: adoption seals it, life goes on in new segments
+        spool2 = ArchiveSpool(SpoolConfig(out_dir=str(tmp_path)),
+                              registry=MetricsRegistry(namespace="t"))
+        spool2.append({"kind": "x", "i": 3})
+        spool2.close()
+        v = verify_archive(tmp_path)
+        assert v["ok"] is True
+        assert v["segments"][0]["partial_tail"] is True
+        assert [r["i"] for r in iter_records(tmp_path)] == [0, 1, 3]
+
+    def test_unwritable_dir_fails_open_and_counts(self, tmp_path):
+        # out_dir is a FILE: makedirs and every segment open fail — the
+        # permission-free unwritable shape (chmod is a no-op under root)
+        reg = MetricsRegistry(namespace="t")
+        ro = tmp_path / "ro"
+        ro.write_text("in the way")
+        spool = ArchiveSpool(SpoolConfig(out_dir=str(ro)), registry=reg)
+        assert spool.append({"kind": "x"}) is False  # no raise
+        assert reg.value("archive_dropped_total",
+                         labels={"reason": "io_error"}) >= 1
+        spool.close()  # no raise either
+
+    def test_unserializable_record_dropped_not_raised(self, tmp_path):
+        reg = MetricsRegistry(namespace="t")
+        spool = ArchiveSpool(SpoolConfig(out_dir=str(tmp_path)),
+                             registry=reg)
+        assert spool.append({"bad": object()}) is False
+        assert reg.value("archive_dropped_total",
+                         labels={"reason": "unserializable"}) == 1
+        assert spool.append({"fine": 1}) is True
+
+
+# -- journal schema version ---------------------------------------------------
+
+
+SAMPLE_DATA = {
+    "batch_close": dict(bucket="256n/512e/128s", cause="occupancy",
+                        occupancy=8, padding=0, depth_after=0,
+                        streams=["s0", "s1"], trace_ids=["w-ab", "w-cd"]),
+    "slo_breach": dict(e2e_sec=3.2, deadline_sec=2.0,
+                       stages={"queue": 0.1, "device": 3.0}),
+    "admission_drop": dict(reason="backpressure"),
+    "reconnect": dict(session=2, healthy=True, delay_sec=1.5, error=None),
+    "config": dict(config_fingerprint="abc123", buckets=["64n/128e/32s"],
+                   window_deadline_sec=2.0),
+    "compile": dict(program="serve_eval[64n]", source="cache",
+                    seconds=0.4, fingerprint="ff00", reason=None),
+    "train_health": dict(step=100, loss=0.5, grad_norm=1.2,
+                         update_ratio=1e-3, steps_per_sec=9.0,
+                         data_wait_fraction=0.05, nonfinite={}),
+    "exception": dict(type="ValueError", message="boom", traceback="..."),
+    "bundle": dict(trigger="p99_breach", path="/tmp/b", reason="r"),
+}
+
+
+class TestSchemaVersion:
+    def test_roundtrip_every_known_kind(self):
+        """Every record kind in the catalog survives
+        to_dict → json → from_dict bit-exactly, with the schema stamp."""
+        jrn = EventJournal(registry=MetricsRegistry(namespace="t"))
+        for kind in KNOWN_KINDS:
+            jrn.record(kind, stream="s0", window_id=3, trace_id="w-ff",
+                       **SAMPLE_DATA.get(kind, {"note": f"sample {kind}"}))
+        records = jrn.tail()
+        assert sorted({r.kind for r in records}) == sorted(KNOWN_KINDS)
+        for rec in records:
+            d = rec.to_dict()
+            assert d["v"] == f"{SCHEMA_VERSION[0]}.{SCHEMA_VERSION[1]}"
+            back = JournalRecord.from_dict(json.loads(json.dumps(d)))
+            assert back.to_dict() == d
+
+    def test_jsonl_roundtrip_through_file(self, tmp_path):
+        jrn = EventJournal(registry=MetricsRegistry(namespace="t"))
+        for kind in KNOWN_KINDS:
+            jrn.record(kind, **SAMPLE_DATA.get(kind, {}))
+        path = tmp_path / "journal.jsonl"
+        jrn.write(path)
+        loaded = load_journal(path)
+        assert [(r.kind, r.seq, r.data) for r in loaded] \
+            == [(r.kind, r.seq, r.data) for r in jrn.tail()]
+
+    def test_newer_minor_tolerated(self):
+        d = JournalRecord(seq=1, t_wall=0.0, t_perf=0.0, kind="x").to_dict()
+        d["v"] = f"{SCHEMA_VERSION[0]}.{SCHEMA_VERSION[1] + 7}"
+        d["future_field"] = "ignored"
+        rec = JournalRecord.from_dict(d)
+        assert rec.kind == "x"
+
+    def test_newer_major_refused_one_line(self, tmp_path):
+        d = JournalRecord(seq=1, t_wall=0.0, t_perf=0.0, kind="x").to_dict()
+        d["v"] = f"{SCHEMA_VERSION[0] + 1}.0"
+        with pytest.raises(SchemaVersionError):
+            JournalRecord.from_dict(d)
+        # load_journal refuses too (does not skip it as malformed)
+        path = tmp_path / "journal.jsonl"
+        path.write_text(json.dumps(d) + "\n")
+        with pytest.raises(SchemaVersionError):
+            load_journal(path)
+        # and the doctor turns it into a polite exit-2 one-liner
+        from nerrf_tpu.flight.doctor import doctor_main
+
+        bdir = tmp_path / "bundle-x"
+        bdir.mkdir()
+        (bdir / "manifest.json").write_text(json.dumps({"trigger": "t"}))
+        (bdir / "journal.jsonl").write_text(json.dumps(d) + "\n")
+        out = []
+        assert doctor_main(bdir, out=out.append) == 2
+        assert len(out) == 1 and "newer than this reader" in out[0]
+
+    def test_report_refuses_newer_major_archive(self, tmp_path):
+        spool = ArchiveSpool(SpoolConfig(out_dir=str(tmp_path)),
+                             registry=MetricsRegistry(namespace="t"))
+        spool.append({"v": f"{SCHEMA_VERSION[0] + 1}.0", "kind": "x"})
+        spool.close()
+        out = []
+        assert report_main([str(tmp_path)], out=out.append) == 2
+        assert "newer than this reader" in out[0]
+
+
+# -- writer -------------------------------------------------------------------
+
+
+class TestWriter:
+    def test_journal_records_flow_to_disk(self, tmp_path):
+        w, reg, jrn = make_writer(tmp_path)
+        jrn.record("config", window_deadline_sec=2.0)
+        jrn.record("admission_drop", stream="s0", reason="oversize")
+        drain(w)
+        w.close()
+        kinds = [r["kind"] for r in iter_records(tmp_path)]
+        assert kinds[0] == "archive_meta"
+        assert "config" in kinds and "admission_drop" in kinds
+        assert reg.value("archive_records_total") >= 3
+        assert reg.value("archive_bytes_total") > 0
+        # writer lag gauge was exported
+        assert "archive_writer_lag_seconds" in reg.snapshot()["gauges"]
+
+    def test_zero_record_loss_vs_in_memory_journal(self, tmp_path):
+        """The acceptance identity: archive contents == the in-memory
+        journal over the run (modulo the ring bound)."""
+        w, reg, jrn = make_writer(tmp_path)
+        for i in range(500):
+            jrn.record("batch_close", bucket="64n", occupancy=1, i=i)
+        drain(w)
+        w.close()
+        ring = [r.seq for r in jrn.tail()]
+        archived = [r["seq"] for r in iter_records(tmp_path)
+                    if r.get("kind") == "batch_close"]
+        assert set(ring) <= set(archived)
+        assert len(archived) == 500
+        assert reg.value("archive_dropped_total",
+                         labels={"reason": "queue_full"}) == 0
+
+    def test_backlog_overflow_drops_counted(self, tmp_path):
+        w, reg, jrn = make_writer(tmp_path, queue_slots=4)
+        # saturate the queue directly (the writer thread is racing us, so
+        # fill far past the bound)
+        for i in range(200):
+            w._enqueue({"kind": "x", "i": i}, t_enq=time.monotonic())
+        drain(w)
+        w.close()
+        assert reg.value("archive_dropped_total",
+                         labels={"reason": "queue_full"}) > 0
+
+    def test_snapshot_cadence_cuts_metrics_and_sketches(self, tmp_path):
+        w, reg, jrn = make_writer(tmp_path, snapshot_every_sec=0.1)
+        reg.gauge_set("capacity_headroom_streams", 4.5, help="t")
+        w.observe_window("64n", nodes=30, edges=60, files=4,
+                         stages={"device": 0.01, "queue": 0.002},
+                         e2e_sec=0.05)
+        time.sleep(0.4)
+        w.close()
+        kinds = [r["kind"] for r in iter_records(tmp_path)]
+        assert "metrics_snapshot" in kinds and "workload_sketch" in kinds
+        snap = next(r for r in iter_records(tmp_path)
+                    if r["kind"] == "metrics_snapshot")
+        assert snap["data"]["gauges"]["capacity_headroom_streams"]
+
+    def test_sketches_accumulate_and_stamp_run(self, tmp_path):
+        w, reg, jrn = make_writer(tmp_path)
+        for i in range(10):
+            w.observe_window("64n/128e/32s", nodes=40 + i, edges=80,
+                             files=3, stages={"device": 0.02},
+                             e2e_sec=0.05)
+        w.close()
+        sk = [r for r in iter_records(tmp_path)
+              if r["kind"] == "workload_sketch"]
+        assert sk and sk[-1]["run"] == w.run_id
+        data = sk[-1]["data"]
+        assert data["sketches"]["window_nodes"]["counts"]
+        assert data["totals"]["windows[64n/128e/32s]"]["count"] == 10
+        assert data["totals"]["device_seconds[64n/128e/32s]"]["sum"] \
+            == pytest.approx(0.2)
+
+    def test_position_tracks_segment_and_seq_range(self, tmp_path):
+        w, reg, jrn = make_writer(tmp_path)
+        jrn.record("config", a=1)
+        jrn.record("readiness", ready=True)
+        drain(w)
+        pos = w.position()
+        assert pos["segment"] and pos["segment"].startswith("seg-")
+        assert pos["journal_seq"]["lo"] == 1
+        assert pos["journal_seq"]["hi"] == 2
+        w.close()
+
+    def test_close_unsubscribes_and_seals(self, tmp_path):
+        w, reg, jrn = make_writer(tmp_path)
+        jrn.record("config", a=1)
+        drain(w)
+        w.close()
+        w.close()  # idempotent
+        jrn.record("config", a=2)  # after close: not archived
+        assert not any(s.endswith(".open") for s in os.listdir(tmp_path))
+        confs = [r for r in iter_records(tmp_path)
+                 if r.get("kind") == "config"]
+        assert len(confs) == 1
+
+    def test_kill_mid_write_archive_still_reports(self, tmp_path):
+        """kill -9 shape end to end: abandoned .open tail + torn final
+        line — the reader, verify and the report all still work."""
+        w, reg, jrn = make_writer(tmp_path)
+        jrn.record("config", window_deadline_sec=2.0)
+        for i in range(20):
+            jrn.record("batch_close", bucket="64n", occupancy=2)
+        drain(w)
+        w._flush_snapshots()
+        # no close(): simulate the process dying; tear the tail by hand
+        open_segs = [s for s in os.listdir(tmp_path)
+                     if s.endswith(".open")]
+        assert open_segs
+        p = tmp_path / open_segs[0]
+        p.write_bytes(p.read_bytes()[:-9])
+        assert verify_archive(tmp_path)["ok"] is True
+        rep = build_report(str(tmp_path))
+        assert rep["span"]["records"] >= 20
+        w.close()  # cleanup (the torn tail seals on close)
+
+
+# -- report / compare / export ------------------------------------------------
+
+
+def _populated_archive(tmp_path, name, device_cost=0.02, breach_every=0,
+                       psi=None, loss=0.4):
+    """A synthetic but fully-shaped archive: serve + train telemetry."""
+    root = tmp_path / name
+    reg = MetricsRegistry(namespace=name)
+    jrn = EventJournal(registry=reg)
+    w = ArchiveWriter(ArchiveConfig(out_dir=str(root),
+                                    snapshot_every_sec=3600.0),
+                      registry=reg, journal=jrn)
+    jrn.record("config", window_deadline_sec=2.0, buckets=["64n/128e/32s"])
+    reg.gauge_set("capacity_headroom_streams", 5.0, help="t")
+    for i in range(40):
+        jrn.record("batch_close", bucket="64n/128e/32s", occupancy=4,
+                   cause="occupancy")
+        w.observe_window("64n/128e/32s", nodes=50, edges=100, files=6,
+                         stages={"queue": 0.005, "pack": 0.001,
+                                 "device": device_cost, "demux": 0.001},
+                         e2e_sec=device_cost + 0.01)
+        if breach_every and i % breach_every == 0:
+            jrn.record("slo_breach", stream="s0", e2e_sec=3.0,
+                       deadline_sec=2.0)
+        if psi is not None:
+            jrn.record("quality_stats", stream="s0", worst_score_psi=psi,
+                       worst_feature_psi=psi / 2, windows=i + 1)
+    jrn.record("train_start", config_fingerprint="cfg", steps=100)
+    for step in (10, 50, 100):
+        jrn.record("train_health", step=step, loss=loss, grad_norm=1.0,
+                   steps_per_sec=8.0, nonfinite={})
+    jrn.record("train_done", steps=100, halted=None)
+    drain(w)
+    w.close()
+    return root
+
+
+class TestReport:
+    def test_offline_report_reconstructs_every_plane(self, tmp_path):
+        root = _populated_archive(tmp_path, "a", breach_every=10, psi=0.1)
+        rep = build_report(str(root))
+        assert rep["slo"]["windows_scored"] == 40
+        assert rep["slo"]["breaches"] == 4
+        assert rep["slo"]["deadline_sec"] == 2.0
+        assert rep["slo"]["e2e_ms"]["p99"] is not None
+        assert rep["capacity"]["headroom_streams_last"] == 5.0
+        assert rep["capacity"]["occupancy_mean"]["64n/128e/32s"] == 4.0
+        assert rep["drift"]["worst_score_psi"] == 0.1
+        progs = rep["efficiency"]["programs"]
+        assert progs["64n/128e/32s"]["device_seconds_mean"] \
+            == pytest.approx(0.02)
+        assert rep["train"]["last"]["loss"] == 0.4
+        assert rep["train"]["health_records"] == 3
+        text = format_report(rep)
+        for section in ("SLO conformance", "capacity:", "drift:",
+                        "device efficiency", "training health"):
+            assert section in text
+
+    def test_short_train_run_reports_markers_without_health_cadence(
+            self, tmp_path):
+        """A run shorter than the monitor's journal cadence archives
+        train_start/train_done but zero train_health records — the
+        report must say so instead of 'no train records'."""
+        reg = MetricsRegistry(namespace="t")
+        jrn = EventJournal(registry=reg)
+        w = ArchiveWriter(ArchiveConfig(out_dir=str(tmp_path),
+                                        snapshot_every_sec=3600.0),
+                          registry=reg, journal=jrn)
+        jrn.record("train_start", config_fingerprint="cfg", steps=12)
+        jrn.record("train_done", steps=12, halted=None)
+        drain(w)
+        w.close()
+        rep = build_report(str(tmp_path))
+        assert rep["train"]["train_starts"] == 1
+        assert rep["train"]["health_records"] == 0
+        assert "run(s) archived" in format_report(rep)
+
+    def test_compare_flags_injected_regression(self, tmp_path):
+        a = _populated_archive(tmp_path, "base", device_cost=0.02)
+        b = _populated_archive(tmp_path, "cand", device_cost=0.1,
+                               breach_every=4, loss=0.9)
+        cmp = compare_reports(build_report(str(a)), build_report(str(b)))
+        assert cmp["ok"] is False
+        whats = " ".join(r["what"] for r in cmp["regressions"])
+        assert "p99 regressed" in whats
+        assert "device seconds per batch regressed" in whats
+        assert "train loss regressed" in whats
+        assert "REGRESSION" in format_compare(cmp)
+        # and the identity diff is clean
+        assert compare_reports(build_report(str(a)),
+                               build_report(str(a)))["ok"] is True
+
+    def test_export_tune_distribution_and_cost_table(self, tmp_path):
+        root = _populated_archive(tmp_path, "a")
+        corpus = export_tune(str(root))
+        assert corpus["windows_observed"] == 40
+        dist = corpus["window_size_distribution"]
+        assert dist["nodes"]["total"] == 40
+        # 50 nodes lands in the (32, 64] bin → right-edge quantile 64
+        assert dist["nodes"]["quantiles"]["p50"] == 64.0
+        cost = corpus["bucket_cost"]["64n/128e/32s"]
+        assert cost["windows"] == 40
+        assert cost["device_seconds_mean"] == pytest.approx(0.02)
+        assert cost["occupancy_mean"] == 4.0
+
+    def test_merge_is_cross_run_exact(self, tmp_path):
+        a = _populated_archive(tmp_path, "hostA")
+        b = _populated_archive(tmp_path, "hostB")
+        out = tmp_path / "merged"
+        summary = merge_archives([str(a), str(b)], str(out))
+        ra, rb = build_report(str(a)), build_report(str(b))
+        rm = build_report(str(out))
+        assert summary["records"] == ra["span"]["records"] \
+            + rb["span"]["records"]
+        # sketch merging is count addition: windows/batches double
+        assert rm["slo"]["windows_scored"] == 80
+        assert rm["efficiency"]["programs"]["64n/128e/32s"]["batches"] == 80
+        assert len(rm["span"]["runs"]) == 2
+        # per-record src attribution survived
+        assert all(r.get("src") in ("hostA", "hostB")
+                   for r in iter_records(out))
+        assert verify_archive(out)["ok"] is True
+
+    def test_multi_dir_report_equals_merged(self, tmp_path):
+        a = _populated_archive(tmp_path, "hostA")
+        b = _populated_archive(tmp_path, "hostB")
+        rep = build_report([str(a), str(b)])
+        assert rep["slo"]["windows_scored"] == 80
+
+
+# -- integration: flight bundle pointer + service demux -----------------------
+
+
+class TestIntegration:
+    def test_bundle_manifest_embeds_archive_position(self, tmp_path):
+        from nerrf_tpu.flight import FlightConfig, FlightRecorder
+        from nerrf_tpu.flight.doctor import format_report as doctor_format
+        from nerrf_tpu.flight.doctor import read_bundle
+
+        reg = MetricsRegistry(namespace="t")
+        jrn = EventJournal(registry=reg)
+        w = ArchiveWriter(ArchiveConfig(out_dir=str(tmp_path / "arch"),
+                                        snapshot_every_sec=3600.0),
+                          registry=reg, journal=jrn)
+        rec = FlightRecorder(
+            FlightConfig(out_dir=str(tmp_path / "flight")),
+            registry=reg, journal=jrn, archive=w)
+        jrn.record("config", a=1)
+        drain(w)
+        path = rec.trigger("guardrail_veto", "test", {})
+        rec.close()
+        w.close()
+        bundle = read_bundle(path)
+        arch = bundle["manifest"]["archive"]
+        assert arch["segment"].startswith("seg-")
+        assert arch["journal_seq"]["lo"] >= 1
+        report = doctor_format(bundle)
+        assert "archive context:" in report
+        assert arch["segment"] in report
+
+    def test_service_demux_feeds_archive_sketches(self, tmp_path):
+        import numpy as np
+
+        from nerrf_tpu.serve.batcher import ScoredWindow
+        from nerrf_tpu.serve.config import ServeConfig
+        from tests.conftest import make_service_shell
+
+        cfg = ServeConfig(buckets=((4, 4, 1),), batch_size=2)
+        svc, registry = make_service_shell(cfg)
+        w = ArchiveWriter(ArchiveConfig(out_dir=str(tmp_path),
+                                        snapshot_every_sec=3600.0),
+                          registry=registry, journal=svc._journal)
+        svc.attach_archive(w)
+        now = time.perf_counter()
+        svc._on_scored([ScoredWindow(
+            stream="s0", window_idx=0, lo_ns=0, hi_ns=1, bucket=(4, 4, 1),
+            probs=np.zeros(4, np.float32),
+            node_type=np.zeros(4, np.int32),
+            node_key=np.zeros(4, np.int64),
+            node_mask=np.ones(4, bool), t_admit=now - 0.05,
+            t_scored=now - 0.01, late=False, trace_id="w-1",
+            t_packed=now - 0.04, t_device=now - 0.03,
+            nodes=4, edges=3, files=2)])
+        w.close()
+        tune = export_tune(str(tmp_path))
+        assert tune["windows_observed"] == 1
+        assert tune["bucket_cost"]["4n/4e/1s"]["windows"] == 1
+
+    def test_archive_cli_roundtrip(self, tmp_path, capsys):
+        from nerrf_tpu import cli
+
+        root = _populated_archive(tmp_path, "a")
+        assert cli.main(["archive", "ls", str(root)]) == 0
+        assert cli.main(["archive", "verify", str(root)]) == 0
+        assert cli.main(["archive", "export", str(root), "--tune",
+                         "--out", str(tmp_path / "tune.json")]) == 0
+        tune = json.loads((tmp_path / "tune.json").read_text())
+        assert tune["kind"] == "nerrf_tune_corpus"
+        capsys.readouterr()  # drain the ls/verify output
+        assert cli.main(["report", str(root), "--json"]) == 0
+        rep = json.loads(capsys.readouterr().out)
+        assert rep["slo"]["windows_scored"] == 40
+        merged = tmp_path / "m"
+        assert cli.main(["archive", "merge", str(root),
+                         "--out", str(merged)]) == 0
+        assert cli.main(["report", "--compare", str(root),
+                         str(merged)]) == 0
+        # doctor on an archive dir renders the report, not a bundle error
+        assert cli.main(["doctor", str(root)]) == 0
+        # prune down to nearly nothing: bound enforced, exit clean
+        assert cli.main(["archive", "prune", str(root),
+                         "--max-bytes", "10"]) == 0
+        assert is_archive_dir(str(root)) in (True, False)
+
+    def test_prune_never_touches_a_live_writers_open_tail(self, tmp_path):
+        """`nerrf archive prune` may run against a LIVE writer's dir:
+        it must delete only sealed segments and leave the .open tail to
+        its owner — adopting it mid-flight would seal a file the writer
+        still appends to (and break its next seal's rename)."""
+        from nerrf_tpu.archive import prune_archive
+
+        spool = ArchiveSpool(
+            SpoolConfig(out_dir=str(tmp_path), segment_max_bytes=200),
+            registry=MetricsRegistry(namespace="t"))
+        for i in range(30):
+            spool.append({"kind": "x", "i": i, "pad": "p" * 40})
+        # spool still live: one .open tail + several sealed segments
+        assert any(s.endswith(".open") for s in os.listdir(tmp_path))
+        out = prune_archive(str(tmp_path), max_total_bytes=0)
+        assert out["pruned"] > 0 and out["live_segments"] == 1
+        assert any(s.endswith(".open") for s in os.listdir(tmp_path))
+        # the live writer keeps appending and sealing without an error
+        for i in range(30, 40):
+            assert spool.append({"kind": "x", "i": i})
+        spool.close()
+        assert not any(s.endswith(".open") for s in os.listdir(tmp_path))
+        assert verify_archive(tmp_path)["ok"] is True
+
+    def test_demux_raising_archive_never_wedges_resolution(self, tmp_path):
+        """An archive observer that raises at the demux boundary must
+        cost at most this window's alert, never the ledger resolution —
+        the fail-open contract the quality observer already has."""
+        import numpy as np
+
+        from nerrf_tpu.serve.batcher import ScoredWindow
+        from nerrf_tpu.serve.config import ServeConfig
+        from tests.conftest import make_service_shell
+
+        class Boom:
+            def observe_window(self, *a, **k):
+                raise RuntimeError("sketch ladder bug")
+
+        cfg = ServeConfig(buckets=((4, 4, 1),), batch_size=2)
+        svc, registry = make_service_shell(cfg)
+        svc.attach_archive(Boom())
+        now = time.perf_counter()
+        svc._on_scored([ScoredWindow(
+            stream="s0", window_idx=0, lo_ns=0, hi_ns=1, bucket=(4, 4, 1),
+            probs=np.zeros(4, np.float32),
+            node_type=np.zeros(4, np.int32),
+            node_key=np.zeros(4, np.int64),
+            node_mask=np.ones(4, bool), t_admit=now - 0.05,
+            t_scored=now - 0.01, late=False, trace_id="w-1",
+            t_packed=now - 0.04, t_device=now - 0.03,
+            nodes=4, edges=3, files=2)])  # must not raise
+        drops = svc._journal.tail(kinds=("demux_drop",))
+        assert len(drops) == 1
+        assert drops[0].data["reason"] == "emit_error"
+
+    def test_report_cli_empty_dir_is_polite(self, tmp_path):
+        from nerrf_tpu import cli
+
+        missing = tmp_path / "nope"
+        assert cli.main(["report", str(missing)]) == 2
+        assert cli.main(["archive", "ls", str(missing)]) == 2

@@ -71,6 +71,18 @@ def test_serve_bench_smoke_runs_and_keeps_parity(repo_root):
     assert comp["resolution_speedup"] >= 5.0
     assert comp["warmup_speedup"] >= 1.5
     assert comp["warm_parity_bit_identical_to_model_detect"] is True
+    # the telemetry-archive leg: archiving rides the noise band, loses
+    # zero journal records, the offline report/tune export agree with
+    # the live run, and forced rotation held the disk bound
+    arch = res["archive"]
+    assert arch["p99_within_noise_band"] is True
+    assert arch["zero_record_loss"] is True
+    assert arch["records_archived"] == arch["records_expected"]
+    assert arch["report_offline_ok"] is True
+    assert arch["tune_export"]["validated_against_live"] is True
+    assert arch["tune_export"]["windows_observed"] \
+        == arch["on"]["windows"] > 0
+    assert arch["rotation"]["disk_bounded"] is True
 
 
 def test_checked_in_swap_artifact_meets_acceptance(repo_root):
@@ -135,3 +147,13 @@ def test_checked_in_serve_artifact_meets_acceptance(repo_root):
     assert comp["resolution_speedup"] >= 5.0
     assert comp["warmup_speedup"] >= 2.5
     assert comp["warm_parity_bit_identical_to_model_detect"] is True
+    # telemetry-archive acceptance in the artifact of record: noise-band
+    # p99, zero record loss, offline report/export agreement, and the
+    # forced-rotation disk bound
+    arch = art["archive"]
+    assert arch["p99_within_noise_band"] is True
+    assert arch["zero_record_loss"] is True
+    assert arch["report_offline_ok"] is True
+    assert arch["tune_export"]["validated_against_live"] is True
+    assert arch["tune_export"]["bucket_cost"]
+    assert arch["rotation"]["disk_bounded"] is True
