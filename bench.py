@@ -731,13 +731,41 @@ def main() -> None:
         return _smoke_or_artifact("swap", "run_swap_bench.py",
                                   "swap_bench_cpu.json", surface)
 
+    def _tune():
+        # learned-ladder loop: serve a skewed mix on a coarse static
+        # ladder, tune from the archive, re-serve on the tuned ladder
+        # (docs/tuning.md)
+        def surface(r):
+            return {
+                "streams": r.get("streams"),
+                "windows_measured": r.get("windows_measured"),
+                "static_ladder": r.get("static_ladder"),
+                "tuned_ladder": r.get("tuned_ladder"),
+                "routing": r.get("routing"),
+                "expected_improvement": r.get("value"),
+                "tuned_beats_static": r.get("tuned_beats_static"),
+                "kernel_bench_crossover_nodes": (
+                    r.get("kernel_bench_prior") or {}).get("nodes"),
+                "corpus_fingerprint": r.get("corpus_fingerprint"),
+                "recompiles_after_warmup": (r.get("reserve") or {}).get(
+                    "recompiles_after_warmup"),
+                "parity_bit_identical": (r.get("reserve") or {}).get(
+                    "parity_bit_identical_to_model_detect"),
+                "backend": r.get("backend"),
+                "smoke": r.get("smoke"),
+                "provenance": r.get("provenance"),
+            }
+
+        return _smoke_or_artifact("tune", "run_tune_bench.py",
+                                  "tune_bench_cpu.json", surface)
+
     # per-artifact isolation: one truncated/corrupt JSON on disk must not
     # silently drop the valid artifacts after it
     for key, loader in (("corpus100h", _j100), ("adversarial", _adv),
                         ("m1_recovery", _recovery), ("tracker", _tracker),
                         ("serve", _serve), ("model_swap", _swap),
                         ("chaos", _chaos), ("quality", _quality),
-                        ("train_health", _train_health)):
+                        ("train_health", _train_health), ("tune", _tune)):
         try:
             entry = loader()
             if entry is not None:

@@ -1,0 +1,277 @@
+#!/usr/bin/env python3
+"""Tune-loop harness: archive a skewed serve mix, fit the learned ladder,
+re-serve on it, and gate that tuned beats static.
+
+The closed loop docs/tuning.md documents, end to end in one process:
+
+1. **Measure** — a service on a deliberately coarse static ladder serves
+   a skewed stream mix (small windows dominating, so most traffic pads
+   far up the bottom rung) with the telemetry archive spooling; this is
+   exactly a production pod's day.
+2. **Fit** — `nerrf archive export --tune` emits the corpus; `tune.tune`
+   fits the cost model and searches ladder + per-rung kernel routing.
+3. **Gate (deterministic)** — the tuned ladder must STRICTLY beat the
+   static one on expected padded device seconds per window *under the
+   same fitted model*.  Both sides of the comparison come from one fit
+   over one corpus, so the verdict is a pure function of the archived
+   run — no wall-clock dependence in the gate itself.
+4. **Re-serve** — a fresh service boots on the tuned ladder with the
+   routing table applied: zero recompiles after warmup across the tuned
+   rungs, and one stream's DetectionResult stays bit-identical to the
+   offline `pipeline.model_detect` at the tuned bucket (the
+   admission/warmup/program-closure contracts hold on ANY ladder this
+   emits).
+
+    python benchmarks/run_tune_bench.py                  # full mix
+    python benchmarks/run_tune_bench.py --smoke          # 2 streams
+    python benchmarks/run_tune_bench.py --out results/tune_bench_cpu.json
+
+Prints ONE JSON line (the artifact) on stdout; exits 1 when tuned fails
+to beat static, parity breaks, or the tuned boot recompiles.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+# Deliberately coarse: the bottom rung is 1024 nodes, so the small-window
+# mix below pads ~16× up — the padding waste the tuner exists to recover
+# (a 256-ish rung).  The top rung keeps the ladder admission-complete for
+# the mix's tail.
+STATIC_LADDER = ((1024, 2048, 128), (4096, 8192, 256))
+
+
+def _feed(svc, stream, events, strings, block=256, timeout=180.0):
+    svc.join(stream)
+    for i in range(0, len(events), block):
+        blk = type(events)(**{f.name: getattr(events, f.name)[i:i + block]
+                              for f in dataclasses.fields(events)})
+        svc.feed(stream, blk, strings)
+    return svc.leave(stream, timeout=timeout)
+
+
+def run(streams: int = 6, sim_seconds: float = 90.0,
+        batch_size: int = 8, close_ms: float = 100.0, smoke: bool = False,
+        log=lambda *a: print(*a, file=sys.stderr, flush=True)) -> dict:
+    """Importable harness body (the tier-1 smoke test calls this
+    in-process).  Returns the artifact dict."""
+    if smoke:
+        streams, sim_seconds = 2, 30.0
+    log = log or (lambda *a: None)
+    import shutil
+
+    import jax
+
+    from nerrf_tpu.archive import ArchiveConfig, ArchiveWriter, export_tune
+    from nerrf_tpu.data.loaders import Trace
+    from nerrf_tpu.data.synth import SimConfig, simulate_trace
+    from nerrf_tpu.flight.journal import EventJournal
+    from nerrf_tpu.models import JointConfig, NerrfNet
+    from nerrf_tpu.observability import MetricsRegistry
+    from nerrf_tpu.pipeline import model_detect
+    from nerrf_tpu.serve import (
+        OnlineDetectionService,
+        ServeConfig,
+        bucket_tag,
+        init_untrained_params,
+    )
+    from nerrf_tpu.tune import (
+        apply_to_model_config,
+        apply_to_serve_config,
+        load_kernel_bench_crossover,
+        tune,
+    )
+
+    backend = jax.default_backend()
+    static_cfg = ServeConfig(
+        buckets=STATIC_LADDER, batch_size=batch_size,
+        batch_close_sec=close_ms / 1000.0,
+        window_sec=15.0, stride_sec=5.0,
+        stream_queue_slots=512, alert_queue_slots=4096,
+        window_deadline_sec=5.0)
+    model = NerrfNet(JointConfig().small)
+    params = init_untrained_params(model, static_cfg)
+
+    # ---- 1: measured leg — skewed mix through the static ladder ------------
+    reg = MetricsRegistry(namespace="tunebench")
+    jrn = EventJournal(capacity=8192, registry=reg)
+    svc = OnlineDetectionService(params, model, cfg=static_cfg,
+                                 registry=reg, journal=jrn)
+    svc.start(log=log)
+    arch_dir = tempfile.mkdtemp(prefix="nerrf-tune-bench-")
+    writer = ArchiveWriter(
+        ArchiveConfig(out_dir=arch_dir, snapshot_every_sec=0.5),
+        registry=reg, journal=jrn, log=log)
+    svc.attach_archive(writer)
+
+    # the skew: every stream is SMALL traffic (tens of nodes per window),
+    # padding ~16× on the static bottom rung; stream parameters vary so
+    # the demand distribution has body and tail, not one spike
+    traces = []
+    t0 = time.perf_counter()
+    errors = {}
+    for i in range(streams):
+        tr = simulate_trace(SimConfig(
+            duration_sec=sim_seconds, attack=(i % 2 == 0),
+            attack_start_sec=sim_seconds / 3,
+            num_target_files=3 + 4 * (i % 3),
+            benign_rate_hz=4.0 + 10.0 * (i % 3), seed=2000 + 131 * i))
+        traces.append(tr)
+        try:
+            _feed(svc, f"s{i}", tr.events, tr.strings)
+        except Exception as e:  # noqa: BLE001 — a stream error is a gate
+            errors[f"s{i}"] = repr(e)
+    measure_wall = round(time.perf_counter() - t0, 2)
+    svc.stop()
+    writer.close()
+    windows_measured = int(reg.value("serve_windows_scored_total"))
+    log(f"[tune-bench] measured leg: {windows_measured} windows over "
+        f"{streams} streams in {measure_wall}s on "
+        f"{[bucket_tag(b) for b in STATIC_LADDER]}")
+
+    # ---- 2: corpus → fit → tuned artifact ----------------------------------
+    corpus = export_tune(arch_dir)
+    shutil.rmtree(arch_dir, ignore_errors=True)
+    kb = load_kernel_bench_crossover(os.path.relpath(
+        Path(__file__).resolve().parent / "results" /
+        "kernel_bench_cpu.json"))
+    art = tune(corpus, model_cfg=model.cfg, kernel_bench=kb,
+               max_rungs=3, static_buckets=STATIC_LADDER)
+    expected = art["expected"]
+    tuned_buckets = tuple(tuple(b) for b in art["buckets"])
+    log(f"[tune-bench] tuned ladder {[bucket_tag(b) for b in tuned_buckets]}"
+        f" routing {art['routing']}: expected "
+        f"{expected['static_device_seconds_per_window']:.4g}s → "
+        f"{expected['tuned_device_seconds_per_window']:.4g}s per window "
+        f"({expected['improvement']:.1%})")
+
+    # ---- 3: re-serve on the tuned ladder -----------------------------------
+    tuned_cfg = apply_to_serve_config(art, static_cfg)
+    tuned_model = NerrfNet(apply_to_model_config(art, model.cfg))
+    reg2 = MetricsRegistry(namespace="tunebench2")
+    jrn2 = EventJournal(capacity=8192, registry=reg2)
+    svc2 = OnlineDetectionService(params, tuned_model, cfg=tuned_cfg,
+                                  registry=reg2, journal=jrn2)
+    t0 = time.perf_counter()
+    svc2.start(log=log)
+    tuned_warmup_wall = round(time.perf_counter() - t0, 2)
+    # p0 re-drives the full skewed stream across the tuned rungs (the
+    # zero-recompile evidence); p1 is the parity stream — low-rate and
+    # file-poor so EVERY window (flush partials included) lands in the
+    # smallest tuned rung, the one bucket offline model_detect will use
+    parity_tr = simulate_trace(SimConfig(
+        duration_sec=min(sim_seconds, 45.0), attack=False,
+        num_target_files=3, benign_rate_hz=1.5, seed=7))
+    served = None
+    try:
+        _feed(svc2, "p0", traces[0].events, traces[0].strings)
+        served = _feed(svc2, "p1", parity_tr.events, parity_tr.strings)
+    except Exception as e:  # noqa: BLE001
+        errors["reserve"] = repr(e)
+    finally:
+        svc2.stop()
+    recompiles = sum(
+        int(reg2.value("serve_recompiles_total",
+                       labels={"bucket": bucket_tag(b)}) or 0)
+        for b in tuned_cfg.buckets)
+
+    # parity: the tuned service's stream vs offline model_detect at the
+    # SAME tuned bucket with the SAME routing-bearing model config — a
+    # tuned ladder changes where windows land and which kernel aggregates,
+    # never what a landed window scores
+    parity = False
+    parity_bucket = None
+    if served is not None:
+        parity_bucket = sorted(tuned_cfg.buckets)[0]
+        offline = model_detect(
+            Trace(events=parity_tr.events, strings=parity_tr.strings,
+                  ground_truth=None, labels=None, name="p1"),
+            params, tuned_model,
+            ds_cfg=tuned_cfg.dataset_config(parity_bucket),
+            auto_capacity=False, batch_size=batch_size)
+        parity = (
+            served.file_scores == offline.file_scores
+            and served.file_window_scores == offline.file_window_scores
+            and served.proc_scores == offline.proc_scores
+            and served.file_bytes == offline.file_bytes
+            and served.threshold == offline.threshold)
+    log(f"[tune-bench] tuned re-serve: warmup {tuned_warmup_wall}s, "
+        f"recompiles {recompiles}, parity at "
+        f"{bucket_tag(parity_bucket) if parity_bucket else None}: {parity}")
+
+    tuned_beats_static = (
+        expected["tuned_device_seconds_per_window"]
+        < expected["static_device_seconds_per_window"])
+    return {
+        "metric": "tuned_vs_static_expected_device_seconds_per_window",
+        "value": round(expected["improvement"], 4),
+        "unit": "fractional improvement, fitted cost model "
+                "(deterministic given the corpus)",
+        "backend": backend,
+        "smoke": smoke or None,
+        "streams": streams,
+        "windows_measured": windows_measured,
+        "measure_wall_seconds": measure_wall,
+        "static_ladder": [bucket_tag(b) for b in STATIC_LADDER],
+        "tuned_ladder": [bucket_tag(b) for b in tuned_buckets],
+        "routing": art["routing"],
+        "expected": expected,
+        "tuned_beats_static": bool(tuned_beats_static),
+        "fit": {k: art["fit"][k] for k in
+                ("alpha", "beta", "dense_gamma", "measured_points",
+                 "demand_points", "candidates_scored")},
+        "kernel_bench_prior": art["fit"]["provenance"]["kernel_bench"],
+        "corpus_fingerprint": art["corpus_fingerprint"],
+        "reserve": {
+            "warmup_wall_seconds": tuned_warmup_wall,
+            "recompiles_after_warmup": recompiles,
+            "parity_bucket": bucket_tag(parity_bucket)
+                if parity_bucket else None,
+            "parity_bit_identical_to_model_detect": bool(parity),
+        },
+        "stream_errors": errors or None,
+        "provenance": "python benchmarks/run_tune_bench.py"
+                      + (" --smoke" if smoke else ""),
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--streams", type=int, default=6)
+    ap.add_argument("--seconds", type=float, default=90.0,
+                    help="simulated seconds of trace per stream")
+    ap.add_argument("--batch-size", type=int, default=8)
+    ap.add_argument("--close-ms", type=float, default=100.0)
+    ap.add_argument("--smoke", action="store_true",
+                    help="2 streams, short traces")
+    ap.add_argument("--out", default=None, metavar="FILE",
+                    help="also write the artifact JSON here")
+    args = ap.parse_args(argv)
+
+    result = run(streams=args.streams, sim_seconds=args.seconds,
+                 batch_size=args.batch_size, close_ms=args.close_ms,
+                 smoke=args.smoke)
+    print(json.dumps(result))
+    if args.out:
+        os.makedirs(os.path.dirname(os.path.abspath(args.out)),
+                    exist_ok=True)
+        with open(args.out, "w") as f:
+            f.write(json.dumps(result, indent=2) + "\n")
+    ok = (result["tuned_beats_static"]
+          and result["reserve"]["recompiles_after_warmup"] == 0
+          and result["reserve"]["parity_bit_identical_to_model_detect"]
+          and not result["stream_errors"])
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -460,12 +460,16 @@ def export_tune(paths, since: Optional[float] = None,
                                        kinds=("workload_sketch",)))
     sketches, totals = _merge_sketches(sketch_records)
     dist = {}
+    rejected = {}
     for feat in ("nodes", "edges", "files"):
         sk = sketches.get(f"window_{feat}")
-        if sk is None:
-            continue
-        dist[feat] = {"sketch": sk.to_dict(), "total": sk.total,
-                      "quantiles": sk.quantiles((0.5, 0.9, 0.99))}
+        if sk is not None:
+            dist[feat] = {"sketch": sk.to_dict(), "total": sk.total,
+                          "quantiles": sk.quantiles((0.5, 0.9, 0.99))}
+        rj = sketches.get(f"rejected_window_{feat}")
+        if rj is not None and rj.total:
+            rejected[feat] = {"sketch": rj.to_dict(), "total": rj.total,
+                              "quantiles": rj.quantiles((0.5, 0.9, 0.99))}
     dev_totals = _tagged(totals, "device_seconds")
     win_totals = _tagged(totals, "windows")
     occ_totals = _tagged(totals, "occupancy")
@@ -484,12 +488,18 @@ def export_tune(paths, since: Optional[float] = None,
             "occupancy_mean": (round(occ["sum"] / occ["count"], 3)
                                if occ and occ["count"] else None),
         }
+    rej_total = totals.get("rejected_windows")
     return {
         "schema": 1,
         "kind": "nerrf_tune_corpus",
         "source": [str(p) for p in paths],
         "windows_observed": sum(t["count"] for t in win_totals.values()),
+        "windows_rejected": int(rej_total["count"]) if rej_total else 0,
         "window_size_distribution": dist or None,
+        # demand beyond the top rung (admission-rejected window sizes) —
+        # what a ladder extension would capture; tune merges this into
+        # its demand points so rejected traffic pulls rungs up
+        "rejected_window_size_distribution": rejected or None,
         "bucket_cost": table or None,
         "provenance": "nerrf archive export --tune",
     }

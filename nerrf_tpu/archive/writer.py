@@ -175,6 +175,20 @@ class ArchiveWriter:
             self._total(f"device_seconds[{bucket}]", max(float(dev), 0.0))
         self._total(f"windows[{bucket}]", 1.0)
 
+    def observe_rejected(self, nodes: int, edges: int, files: int) -> None:
+        """One window admission REJECTED for size (no rung fits) — the
+        demand beyond the top rung.  Recording its structure (not just a
+        count) is what lets the `nerrf tune` corpus see the traffic a
+        ladder extension would capture; same sketch plane, separate
+        names, so the admitted distribution stays uncontaminated."""
+        self.observe_named("rejected_window_nodes", float(nodes),
+                           ladder="count")
+        self.observe_named("rejected_window_edges", float(edges),
+                           ladder="count")
+        self.observe_named("rejected_window_files", float(files),
+                           ladder="count")
+        self._total("rejected_windows", 1.0)
+
     def observe_named(self, name: str, value: float,
                       ladder: str = "latency") -> None:
         """Feed one value into the named workload sketch (train loops and

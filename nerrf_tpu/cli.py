@@ -998,6 +998,24 @@ def cmd_serve_detect(args) -> int:
             tuple(int(x) for x in b.split("x")) for b in args.buckets)
     cfg = ServeConfig(**cfg_kwargs)
 
+    tuned_art = None
+    if getattr(args, "tuned", None):
+        # tuned-ladder boot (docs/tuning.md): the artifact's rung set
+        # replaces the ladder (including any --buckets) and its routing
+        # table rides into the model config below — warmup then compiles
+        # exactly the tuned programs, admission admits exactly their
+        # reachable shapes, so the zero-recompile contract is unchanged
+        from nerrf_tpu.tune import TuneError, apply_to_serve_config, load_artifact
+
+        try:
+            tuned_art = load_artifact(args.tuned)
+        except TuneError as e:
+            _log(str(e))
+            return 2
+        cfg = apply_to_serve_config(tuned_art, cfg)
+        _log(f"tuned ladder from {args.tuned}: {len(cfg.buckets)} rung(s), "
+             f"routing {tuned_art.get('routing')}")
+
     # chaos plane (docs/chaos.md): arm a fault plan for a game day —
     # --chaos-plan wins, else $NERRF_CHAOS_PLAN (one env var on the pod).
     # Neither set → every fault point stays a free no-op.  A bad plan is
@@ -1083,6 +1101,11 @@ def cmd_serve_detect(args) -> int:
              "(load testing only — scores carry no meaning)")
         model = NerrfNet(JointConfig().small)
         params = init_untrained_params(model, cfg)
+
+    if tuned_art is not None:
+        from nerrf_tpu.tune import apply_to_model_config
+
+        model = NerrfNet(apply_to_model_config(tuned_art, model.cfg))
 
     service = OnlineDetectionService(params, model, cfg=cfg,
                                      compile_cache=compile_cache,
@@ -1225,6 +1248,15 @@ def cmd_serve_detect(args) -> int:
             reason: DEFAULT_REGISTRY.value(
                 "serve_admission_dropped_total", labels={"reason": reason})
             for reason in ("backpressure", "oversize", "leave", "closed")}
+        # per-bucket recompile counter summed over the served ladder: the
+        # zero-recompile contract made scriptable (the tune smoke in
+        # e2e.sh asserts this is 0 on a tuned boot)
+        from nerrf_tpu.serve.config import bucket_tag as _btag
+
+        summary["recompiles_after_warmup"] = sum(
+            DEFAULT_REGISTRY.value("serve_recompiles_total",
+                                   labels={"bucket": _btag(b)}) or 0
+            for b in cfg.buckets)
         print(json.dumps(summary, indent=2))
         return 0
     except BaseException as e:
@@ -1388,6 +1420,20 @@ def cmd_archive(args) -> int:
             return 0
         if args.archive_cmd == "export":
             corpus = export_tune(args.dir)
+            # polite refusal, not a garbage corpus: an archive with no
+            # scored windows or no per-bucket cost rows cannot feed a
+            # fit — say so in one line and exit nonzero
+            if not corpus["windows_observed"]:
+                _log(f"refusing to export: archive {args.dir} holds no "
+                     "observed windows (run a serve with --archive-dir "
+                     "first)")
+                return 1
+            if not corpus.get("bucket_cost"):
+                _log(f"refusing to export: archive {args.dir} has no "
+                     "per-bucket cost table (device-stage telemetry "
+                     "missing) — the tune fit would have nothing to "
+                     "measure")
+                return 1
             text = json.dumps(corpus, indent=2)
             if args.out:
                 Path(args.out).write_text(text + "\n")
@@ -1395,7 +1441,7 @@ def cmd_archive(args) -> int:
                      f"({corpus['windows_observed']} windows observed)")
             else:
                 print(text)
-            return 0 if corpus["windows_observed"] else 1
+            return 0
     except SchemaVersionError as e:
         _log(f"cannot read archive: {e}")
         return 2
@@ -1403,6 +1449,79 @@ def cmd_archive(args) -> int:
         _log(f"not an archive directory: {e}")
         return 2
     return 2
+
+
+def cmd_tune(args) -> int:
+    """Fit the learned bucket ladder + per-rung kernel routing from an
+    archived cost corpus and emit the versioned tuned-ladder artifact
+    (docs/tuning.md).  Deterministic: same corpus → same artifact, so the
+    tuned-vs-static comparison inside is reproducible evidence, not a
+    wall-clock sample."""
+    from nerrf_tpu.tune import (
+        TuneError,
+        load_kernel_bench_crossover,
+        save_artifact,
+        tune,
+    )
+
+    src = Path(args.corpus)
+    try:
+        if src.is_dir():
+            # convenience: point at an archive dir and we export inline
+            from nerrf_tpu.archive import export_tune
+
+            corpus = export_tune(src)
+        else:
+            try:
+                corpus = json.loads(src.read_text())
+            except FileNotFoundError:
+                _log(f"no such corpus file or archive directory: {src}")
+                return 1
+            except ValueError as e:
+                _log(f"corpus {src} is not JSON ({e})")
+                return 1
+
+        model_cfg = None
+        analytic = None
+        if args.model_dir:
+            # the checkpoint's real architecture sizes the cost model's
+            # work terms, and its analytic devtime surface anchors
+            # thin/missing buckets — both optional, both fail-open
+            from nerrf_tpu.models import NerrfNet
+            from nerrf_tpu.train.checkpoint import load_checkpoint
+
+            params, model_cfg = load_checkpoint(args.model_dir)
+            try:
+                from nerrf_tpu.devtime.costmodel import serve_program_costs
+                from nerrf_tpu.serve.config import ServeConfig
+                from nerrf_tpu.train.loop import make_eval_fn
+
+                costs = serve_program_costs(
+                    make_eval_fn(NerrfNet(model_cfg)), params,
+                    ServeConfig())
+                analytic = {tag: c.flops for tag, c in costs.items()}
+            except Exception as e:  # noqa: BLE001 — prior, not gate
+                _log(f"analytic cost surface unavailable ({e}); fitting "
+                     f"from measurements alone")
+
+        kb = load_kernel_bench_crossover(args.kernel_bench)
+        art = tune(corpus, model_cfg=model_cfg, analytic=analytic,
+                   kernel_bench=kb, max_rungs=args.max_rungs)
+    except TuneError as e:
+        _log(f"refusing to tune: {e}")
+        return 1
+
+    exp = art["expected"]
+    if args.out:
+        save_artifact(args.out, art)
+        _log(f"tuned ladder written to {args.out}: "
+             f"{len(art['buckets'])} rung(s), expected "
+             f"{exp['static_device_seconds_per_window']:.3g}s → "
+             f"{exp['tuned_device_seconds_per_window']:.3g}s per window "
+             f"({exp['improvement']:.1%} improvement)")
+    if args.json or not args.out:
+        print(json.dumps(art, indent=2))
+    return 0
 
 
 def cmd_report(args) -> int:
@@ -1635,6 +1754,11 @@ def main(argv=None) -> int:
     p.add_argument("--buckets", nargs="*", default=None, metavar="NxExS",
                    help="capacity-bucket ladder, e.g. 256x512x128 "
                         "1024x2048x128 (default: the warmup ladder)")
+    p.add_argument("--tuned", default=None, metavar="FILE",
+                   help="tuned-ladder artifact from `nerrf tune`: serve on "
+                        "its fitted bucket ladder + per-rung kernel "
+                        "routing table (overrides --buckets; "
+                        "docs/tuning.md)")
     p.add_argument("--batch-size", type=int, default=8,
                    help="padded device batch slots per launch")
     p.add_argument("--close-ms", type=float, default=50.0,
@@ -1924,6 +2048,36 @@ def main(argv=None) -> int:
     ar.add_argument("--out", default=None, metavar="FILE",
                     help="write the corpus JSON here instead of stdout")
     ar.set_defaults(fn=cmd_archive)
+
+    p = sub.add_parser("tune", help="fit a learned bucket ladder + "
+                                    "per-rung kernel routing from an "
+                                    "archived cost corpus; emits the "
+                                    "tuned-ladder artifact serve-detect "
+                                    "--tuned and the AOT re-export "
+                                    "consume (docs/tuning.md)")
+    p.add_argument("corpus", help="tune corpus JSON (`nerrf archive "
+                                  "export --tune --out`) or an archive "
+                                  "directory to export inline")
+    p.add_argument("--out", default=None, metavar="FILE",
+                   help="write the tuned-ladder artifact here (default: "
+                        "print to stdout)")
+    p.add_argument("--model-dir", default=None, metavar="DIR",
+                   help="checkpoint whose architecture sizes the cost "
+                        "model and whose analytic devtime surface anchors "
+                        "thin buckets (default: the stock detector "
+                        "config, measurements only)")
+    p.add_argument("--max-rungs", type=int, default=None,
+                   help="rung-count bound for the ladder search "
+                        "(default: the static ladder's graph-rung count)")
+    p.add_argument("--kernel-bench",
+                   default="benchmarks/results/kernel_bench_cpu.json",
+                   metavar="FILE",
+                   help="kernel microbenchmark artifact whose measured "
+                        "dense/fused crossover calibrates the routing "
+                        "prior (missing file: the authored constant)")
+    p.add_argument("--json", action="store_true",
+                   help="print the artifact JSON even with --out")
+    p.set_defaults(fn=cmd_tune)
 
     p = sub.add_parser("report", help="offline fleet report over archived "
                                       "telemetry: SLO/capacity/drift/"

@@ -59,7 +59,8 @@ def serve_program_key(model_cfg, bucket_tag: str) -> dict:
 
 def export_executables(out_dir, params, model, serve_cfg,
                        batch_size: Optional[int] = None,
-                       journal=None, registry=None, log=None) -> dict:
+                       journal=None, registry=None, log=None,
+                       tuned_stamp: Optional[dict] = None) -> dict:
     """Compile + serialize the eval program for every ladder bucket into
     ``out_dir`` and return the manifest.  Buckets whose executable cannot
     be serialized on this backend are recorded in the manifest with an
@@ -115,25 +116,50 @@ def export_executables(out_dir, params, model, serve_cfg,
         "model": repr(model.cfg),
         "programs": programs,
     }
+    if tuned_stamp is not None:
+        # provenance for tuned-ladder sidecars: which artifact the
+        # exported rung set + routing came from (corpus fingerprint +
+        # expected win), so a sidecar is attributable to its fit
+        manifest["tuned"] = tuned_stamp
     out_dir.mkdir(parents=True, exist_ok=True)
     (out_dir / MANIFEST).write_text(json.dumps(manifest, indent=2))
     return manifest
 
 
 def export_for_checkpoint(ckpt_dir, serve_cfg=None,
-                          journal=None, log=None) -> dict:
+                          journal=None, log=None, tuned=None) -> dict:
     """Load a checkpoint and export its serve-ladder executables into
     ``<ckpt_dir>/executables/`` (the sidecar `ModelRegistry.publish`
-    carries along).  Returns the manifest."""
+    carries along).  Returns the manifest.
+
+    ``tuned`` is an optional tuned-ladder artifact (the dict
+    `tune.load_artifact` returns): the export then runs over the TUNED
+    rung set with the artifact's routing table stamped into the model
+    config — re-exporting a published version onto a fitted ladder is
+    exactly this call at publish time (docs/tuning.md)."""
     from nerrf_tpu.models import NerrfNet
     from nerrf_tpu.serve.config import ServeConfig
     from nerrf_tpu.train.checkpoint import load_checkpoint
 
     ckpt_dir = Path(ckpt_dir).absolute()
     params, model_cfg = load_checkpoint(ckpt_dir)
+    serve_cfg = serve_cfg or ServeConfig()
+    tuned_stamp = None
+    if tuned is not None:
+        from nerrf_tpu.tune.artifact import (
+            apply_to_model_config,
+            apply_to_serve_config,
+        )
+        serve_cfg = apply_to_serve_config(tuned, serve_cfg)
+        model_cfg = apply_to_model_config(tuned, model_cfg)
+        tuned_stamp = {
+            "corpus_fingerprint": tuned.get("corpus_fingerprint"),
+            "expected": tuned.get("expected"),
+            "routing": tuned.get("routing"),
+        }
     return export_executables(
         ckpt_dir / EXECUTABLES_DIR, params, NerrfNet(model_cfg),
-        serve_cfg or ServeConfig(), journal=journal, log=log)
+        serve_cfg, journal=journal, log=log, tuned_stamp=tuned_stamp)
 
 
 def read_manifest(exe_dir) -> Optional[dict]:

@@ -24,7 +24,7 @@ sinusoidal encoding of the window's position in the stream.
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Dict
+from typing import Any, Dict, Optional, Tuple
 
 import flax.linen as nn
 import jax
@@ -102,6 +102,34 @@ class GraphSAGEConfig:
     # on TPU, dense_adj up to DENSE_ADJ_MAX_NODES and fused above it;
     # segment elsewhere.
     aggregation: str = "auto"
+    # Per-rung kernel routing table fitted by `nerrf tune` (docs/tuning.md):
+    # sorted ((max_nodes, mode), ...) pairs consulted BEFORE the auto
+    # constant — the smallest entry whose max_nodes covers the padded node
+    # bucket wins, buckets past the table fall through to the auto rule.
+    # None (the default) keeps the single measured DENSE_ADJ_MAX_NODES
+    # constant, so untuned deployments are bit-for-bit what they were.
+    # The table rides repr(), so serve_program_key / the compile cache key
+    # change with it — a tuned routing can never collide with an untuned
+    # executable.
+    routing: Optional[Tuple[Tuple[int, str], ...]] = None
+
+    def __post_init__(self):
+        # canonicalize the routing table (JSON round-trips hand back
+        # lists; repr() is cache-key material, so the shape must be ONE
+        # shape) and reject junk at construction, not trace time
+        if self.routing is not None:
+            table = tuple(sorted((int(cap), str(mode))
+                                 for cap, mode in self.routing))
+            for cap, mode in table:
+                if mode not in ("fused", "dense_adj", "segment"):
+                    raise ValueError(
+                        f"unknown aggregation {mode!r} in routing table; "
+                        "expected 'fused', 'dense_adj' or 'segment'")
+                if cap <= 0:
+                    raise ValueError(
+                        f"routing table max_nodes must be positive, "
+                        f"got {cap}")
+            object.__setattr__(self, "routing", table)
 
     @property
     def small(self) -> "GraphSAGEConfig":
@@ -113,16 +141,22 @@ class GraphSAGEConfig:
         process's default backend — the single definition of the "auto"
         rule (the model and the bench's kernel_path attribution both call
         this, so the artifact cannot drift from the compute).  ``num_nodes``
-        is the padded node bucket: on TPU, `auto` keeps the dense adjacency
-        where O(N²) MXU work still wins (≤ DENSE_ADJ_MAX_NODES, measured —
-        see the constant) and routes bigger buckets to the fused O(E)
-        kernel; with no bucket given it assumes the large-bucket answer."""
+        is the padded node bucket: a tuned per-rung routing table (see
+        ``routing``) wins first; otherwise on TPU, `auto` keeps the dense
+        adjacency where O(N²) MXU work still wins (≤ DENSE_ADJ_MAX_NODES,
+        measured — see the constant) and routes bigger buckets to the
+        fused O(E) kernel; with no bucket given it assumes the
+        large-bucket answer."""
         if self.aggregation != "auto":
             if self.aggregation not in ("fused", "dense_adj", "segment"):
                 raise ValueError(
                     f"unknown aggregation {self.aggregation!r}; expected "
                     "'auto', 'fused', 'dense_adj' or 'segment'")
             return self.aggregation
+        if self.routing and num_nodes is not None:
+            for cap, mode in self.routing:  # sorted: smallest cover wins
+                if num_nodes <= cap:
+                    return mode
         if jax.default_backend() != "tpu":
             return "segment"
         if num_nodes is not None and num_nodes <= DENSE_ADJ_MAX_NODES:

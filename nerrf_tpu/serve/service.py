@@ -852,6 +852,18 @@ class OnlineDetectionService:
                     "admission_drop", stream=handle.id, window_id=idx,
                     trace_id=trace_id, reason="oversize",
                     nodes=int(n), edges=int(e), files=int(files))
+                try:
+                    if self._archive is not None:
+                        # rejected-demand sketches: record the oversize
+                        # window's STRUCTURE, not just a count, so the
+                        # tune corpus sees the traffic a taller ladder
+                        # would capture.  Fail-open like every archive
+                        # observer — telemetry loss must never become an
+                        # admission fault
+                        self._archive.observe_rejected(
+                            nodes=int(n), edges=int(e), files=int(files))
+                except Exception:  # noqa: BLE001
+                    pass
                 return
             sp.args["bucket"] = bucket_tag(bucket)
             sample, _stats = window_sample(

@@ -1,0 +1,41 @@
+"""`nerrf tune`: learned bucket ladder + per-bucket kernel routing.
+
+Fits a latency+padding cost model over the archived tune corpus
+(`nerrf archive export --tune`), searches rung placement and per-rung
+kernel choice, and emits the versioned tuned-ladder artifact every
+deployment surface consumes (``--tuned`` on serve-detect, the AOT
+re-export).  docs/tuning.md is the runbook.
+"""
+
+from nerrf_tpu.tune.artifact import (
+    ARTIFACT_KIND,
+    ARTIFACT_SCHEMA,
+    TuneError,
+    apply_to_model_config,
+    apply_to_serve_config,
+    build_artifact,
+    load_artifact,
+    save_artifact,
+    validate_artifact,
+)
+from nerrf_tpu.tune.costmodel import (
+    LadderCostModel,
+    fit_cost_model,
+    load_kernel_bench_crossover,
+    parse_tag,
+)
+from nerrf_tpu.tune.search import (
+    demand_points,
+    expected_cost,
+    search_ladder,
+    tune,
+)
+
+__all__ = [
+    "ARTIFACT_KIND", "ARTIFACT_SCHEMA", "TuneError",
+    "apply_to_model_config", "apply_to_serve_config", "build_artifact",
+    "load_artifact", "save_artifact", "validate_artifact",
+    "LadderCostModel", "fit_cost_model", "load_kernel_bench_crossover",
+    "parse_tag", "demand_points", "expected_cost", "search_ladder",
+    "tune",
+]

@@ -123,6 +123,27 @@ print(f"e2e: archive report reconstructs the run offline "
       f"{r['slo']['windows_scored']} windows)")
 EOF
 
+# pre-flight: tune smoke — the learned-ladder loop end to end on the
+# archived toy serve run above: `nerrf tune` fits a tuned ladder +
+# per-rung kernel routing from the segments alone (deterministic: same
+# corpus, same artifact), and a fresh serve boot on the artifact must
+# score windows with ZERO post-warmup recompiles (docs/tuning.md).
+# Pinned to CPU: the fit is pure arithmetic over the corpus.
+timeout 120 env JAX_PLATFORMS=cpu python -m nerrf_tpu.cli tune \
+    "$WORK/archive" --out "$WORK/tuned.json" 2>> "$WORK/archive_serve.log"
+timeout 300 env JAX_PLATFORMS=cpu python -m nerrf_tpu.cli serve-detect \
+    --trace datasets/traces/toy_trace.csv --no-probe --metrics-port -1 \
+    --tuned "$WORK/tuned.json" --no-aot-cache \
+    > "$WORK/tuned_serve.json" 2>> "$WORK/archive_serve.log"
+python - "$WORK/tuned_serve.json" <<'EOF'
+import json, sys
+r = json.load(open(sys.argv[1]))
+assert r["windows_scored"] > 0, "tuned-ladder boot scored nothing"
+assert r["recompiles_after_warmup"] == 0, "tuned boot recompiled post-warmup"
+print(f"e2e: tuned-ladder boot scores {int(r['windows_scored'])} windows, "
+      "zero post-warmup recompiles")
+EOF
+
 # pre-flight: devtime smoke — the device-efficiency cost table (analytic
 # FLOPs / byte floor / roofline intensity for the serve ladder + flat
 # train step) resolves on CPU with every chip-relative column null

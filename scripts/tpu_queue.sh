@@ -99,8 +99,28 @@ then
   log "PRE-FLIGHT FAIL: archive report gates (/tmp/archive_report.json)"
   exit 1
 fi
-rm -rf /tmp/archive_smoke
 log "pre-flight: archive report reconstructs the run offline"
+# pre-flight: tune smoke on CPU — `nerrf tune` fits a tuned ladder +
+# per-rung routing from the archived serve run above, then a fresh boot
+# on the artifact must score windows with ZERO post-warmup recompiles
+# (docs/tuning.md); proves the learned-ladder loop before chip time
+if ! { timeout 120 env JAX_PLATFORMS=cpu python -m nerrf_tpu.cli tune \
+    /tmp/archive_smoke --out /tmp/tuned_smoke.json >> /tmp/tpu_queue.log 2>&1 \
+  && timeout 300 env JAX_PLATFORMS=cpu python -m nerrf_tpu.cli serve-detect \
+    --trace datasets/traces/toy_trace.csv --no-probe --metrics-port -1 \
+    --tuned /tmp/tuned_smoke.json --no-aot-cache \
+    > /tmp/tuned_serve.json 2>> /tmp/tpu_queue.log \
+  && python -c "
+import json
+r = json.load(open('/tmp/tuned_serve.json'))
+assert r['windows_scored'] > 0 and r['recompiles_after_warmup'] == 0
+" ; }
+then
+  log "PRE-FLIGHT FAIL: tuned-ladder boot gates (/tmp/tuned_serve.json)"
+  exit 1
+fi
+rm -rf /tmp/archive_smoke
+log "pre-flight: tuned-ladder boot scores windows, zero post-warmup recompiles"
 # pre-flight: devtime cost table on CPU — the analytic cost model must
 # resolve for the whole serve ladder + train step with every
 # chip-relative column null (docs/device-efficiency.md); fails in

@@ -79,8 +79,27 @@ then
   log "PRE-FLIGHT FAIL: archive report gates (/tmp/archive_report.json)"
   exit 1
 fi
-rm -rf /tmp/archive_smoke
 log "pre-flight: archive report reconstructs the run offline"
+# same tune pre-flight as tpu_queue.sh: fit a tuned ladder from the
+# archived run above, boot it, require zero post-warmup recompiles
+# (docs/tuning.md)
+if ! { timeout 120 env JAX_PLATFORMS=cpu python -m nerrf_tpu.cli tune \
+    /tmp/archive_smoke --out /tmp/tuned_smoke.json >> /tmp/tpu_queue.log 2>&1 \
+  && timeout 300 env JAX_PLATFORMS=cpu python -m nerrf_tpu.cli serve-detect \
+    --trace datasets/traces/toy_trace.csv --no-probe --metrics-port -1 \
+    --tuned /tmp/tuned_smoke.json --no-aot-cache \
+    > /tmp/tuned_serve.json 2>> /tmp/tpu_queue.log \
+  && python -c "
+import json
+r = json.load(open('/tmp/tuned_serve.json'))
+assert r['windows_scored'] > 0 and r['recompiles_after_warmup'] == 0
+" ; }
+then
+  log "PRE-FLIGHT FAIL: tuned-ladder boot gates (/tmp/tuned_serve.json)"
+  exit 1
+fi
+rm -rf /tmp/archive_smoke
+log "pre-flight: tuned-ladder boot scores windows, zero post-warmup recompiles"
 # same devtime pre-flight as tpu_queue.sh: the cost table must resolve
 # on CPU with chip-relative columns null (docs/device-efficiency.md)
 if ! timeout 300 env JAX_PLATFORMS=cpu python -m nerrf_tpu.cli profile costs \

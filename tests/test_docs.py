@@ -12,7 +12,7 @@ def test_all_pages_present_and_linked(repo_root):
             "operations.md", "benchmarks.md", "configuration.md",
             "flight-recorder.md", "chaos.md",
             "device-efficiency.md", "quality.md",
-            "training-health.md"} <= pages
+            "training-health.md", "tuning.md"} <= pages
     # every relative .md link in every page resolves
     for p in docs.glob("*.md"):
         for target in re.findall(r"\]\(([\w\-]+\.md)\)", p.read_text()):
@@ -28,7 +28,7 @@ def test_referenced_cli_commands_exist(repo_root):
     parser_cmds = {"simulate", "train-detector", "undo", "status", "serve",
                    "serve-detect", "ingest", "trace", "warmup", "doctor",
                    "models", "lint", "cache", "chaos", "profile",
-                   "quality", "archive", "report"}
+                   "quality", "archive", "report", "tune"}
     assert referenced <= parser_cmds
     # and the parser really accepts them
     for cmd in parser_cmds:

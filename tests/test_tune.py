@@ -1,0 +1,319 @@
+"""`nerrf tune`: corpus → fitted cost model → ladder/routing search →
+versioned artifact, and the deployment surfaces that consume it.
+
+The golden-corpus fixture is hand-authored (no service, no clock): a
+skewed window mix — 80 small windows padding 3× up the static bottom
+rung, a 900-node body, an 1800-node tail — with measured per-bucket
+costs for the two rungs that served it.  Everything downstream of
+`tune()` must be a pure function of this dict.
+"""
+
+import dataclasses
+import json
+
+import pytest
+
+from nerrf_tpu.tune import (
+    ARTIFACT_KIND,
+    ARTIFACT_SCHEMA,
+    TuneError,
+    apply_to_model_config,
+    apply_to_serve_config,
+    build_artifact,
+    demand_points,
+    fit_cost_model,
+    load_artifact,
+    save_artifact,
+    tune,
+    validate_artifact,
+)
+
+# -- fixture corpora ----------------------------------------------------------
+
+
+def _dist(values):
+    from nerrf_tpu.quality.sketch import COUNT_EDGES, Sketch
+
+    sk = Sketch.empty(COUNT_EDGES)
+    sk.observe([float(v) for v in values])
+    return {"sketch": sk.to_dict(), "total": sk.total, "quantiles": {}}
+
+
+def golden_corpus():
+    nodes = [300] * 80 + [900] * 15 + [1800] * 5
+    edges = [2 * n - 10 for n in nodes]
+    files = [20] * 80 + [60] * 15 + [120] * 5
+    return {
+        "schema": 1, "kind": "nerrf_tune_corpus",
+        "source": "golden-fixture",
+        "windows_observed": 100, "windows_rejected": 0,
+        "window_size_distribution": {
+            "nodes": _dist(nodes), "edges": _dist(edges),
+            "files": _dist(files)},
+        "rejected_window_size_distribution": None,
+        "bucket_cost": {
+            "1024n/2048e/128s": {"windows": 80, "batches": 10,
+                                 "device_seconds_mean": 0.04,
+                                 "device_seconds_p99": 0.06,
+                                 "occupancy_mean": 8.0},
+            "2048n/4096e/256s": {"windows": 20, "batches": 4,
+                                 "device_seconds_mean": 0.09,
+                                 "device_seconds_p99": 0.12,
+                                 "occupancy_mean": 5.0}},
+        "provenance": {"segments": 1},
+    }
+
+
+# -- the fit + search pipeline ------------------------------------------------
+
+
+def test_golden_corpus_deterministic_artifact():
+    """Same corpus → bit-identical artifact (the ISSUE's determinism
+    gate), with the pinned ladder/routing the fixture is golden FOR: a
+    3× -tighter 512 rung for the bulk, the measured rungs kept for body
+    and tail, per-rung kernel routing replacing the global constant."""
+    art = tune(golden_corpus())
+    art2 = tune(json.loads(json.dumps(golden_corpus())))
+    assert art == art2
+    assert art["kind"] == ARTIFACT_KIND and art["schema"] == ARTIFACT_SCHEMA
+    assert art["buckets"] == [[512, 1024, 32], [1024, 2048, 128],
+                              [2048, 4096, 128]]
+    assert dict(art["routing"])[512] == "dense_adj"
+    assert set(dict(art["routing"])) == {512, 1024, 2048}
+    exp = art["expected"]
+    assert (exp["tuned_device_seconds_per_window"]
+            < exp["static_device_seconds_per_window"])
+    assert exp["improvement"] == pytest.approx(0.2478, abs=2e-3)
+    # the measured rung stays evidence-tier "measured"; extrapolated
+    # rungs say so
+    assert art["fit"]["rung_sources"]["1024n/2048e/128s"] == "measured"
+    assert art["fit"]["rung_sources"]["512n/1024e/32s"] == "measured_fit"
+
+
+def test_static_ladder_is_in_the_candidate_set():
+    """tuned can never be worse than static under the fitted model —
+    with the corpus's own rungs passed as the static ladder, improvement
+    is still >= 0 (the search returns static when nothing beats it)."""
+    art = tune(golden_corpus(),
+               static_buckets=((1024, 2048, 128), (2048, 4096, 256)))
+    assert art["expected"]["improvement"] >= 0.0
+
+
+def test_thin_corpus_anchors_on_analytic_prior():
+    """A rung the corpus never measured but the devtime surface traced
+    is priced from the analytic anchor (level) + fitted delta — and the
+    artifact SAYS so, so an operator can see which rungs rest on a
+    prior rather than evidence."""
+    corpus = golden_corpus()
+    del corpus["bucket_cost"]["2048n/4096e/256s"]
+    analytic = {"1024n/2048e/128s": 2.0e9, "512n/1024e/128s": 6.0e8,
+                "2048n/4096e/256s": 7.0e9}
+    model = fit_cost_model(corpus, analytic=analytic)
+    assert model.analytic_alpha is not None
+    assert model.source((512, 1024, 32), "fused") == "analytic_prior"
+    assert model.source((1024, 2048, 128),
+                        model.auto_mode((1024, 2048, 128))) == "measured"
+    art = tune(corpus, analytic=analytic)
+    assert "analytic_prior" in art["fit"]["rung_sources"].values()
+
+
+def test_demand_points_see_single_marginal_tails():
+    """The comonotone coupling takes EVERY marginal's bin boundaries: a
+    tail that lives only in the edges marginal (attack bursts — few
+    nodes, thousands of event edges) must surface as a demand point, or
+    the search would propose ladders whose edge capacity rejects real
+    traffic."""
+    corpus = golden_corpus()
+    nodes = [100] * 90 + [150] * 10
+    edges = [200] * 90 + [3000] * 10
+    files = [20] * 100
+    corpus["window_size_distribution"] = {
+        "nodes": _dist(nodes), "edges": _dist(edges), "files": _dist(files)}
+    points = demand_points(corpus)
+    assert any(p.edges >= 3000 and p.nodes <= 256 for p in points)
+
+
+def test_search_covers_file_demand_instead_of_truncating():
+    """Sequence capacity is a search dimension, but seq-truncation is
+    priced like rejection: the tuned ladder's tallest seq rung must
+    cover the file tail (here 120 files → a 128-seq rung), never "win"
+    by silently dropping sequences."""
+    art = tune(golden_corpus())
+    assert max(b[2] for b in art["buckets"]) >= 128
+
+
+def test_refusals_are_one_line_tune_errors():
+    empty = dict(golden_corpus(), windows_observed=0)
+    with pytest.raises(TuneError, match="empty"):
+        tune(empty)
+    no_cost = dict(golden_corpus(), bucket_cost=None)
+    with pytest.raises(TuneError, match="bucket_cost"):
+        tune(no_cost)
+    with pytest.raises(TuneError, match="kind"):
+        tune({"kind": "something_else"})
+    for err in (TuneError("a"), ):
+        assert "\n" not in str(err)
+
+
+def test_cli_tune_refuses_empty_corpus(tmp_path, capsys):
+    import nerrf_tpu.cli as cli
+
+    p = tmp_path / "corpus.json"
+    p.write_text(json.dumps(dict(golden_corpus(), windows_observed=0)))
+    assert cli.main(["tune", str(p)]) == 1
+    err = capsys.readouterr().err
+    assert "refusing to tune" in err
+
+
+def test_cli_tune_emits_loadable_artifact(tmp_path, repo_root, monkeypatch):
+    import nerrf_tpu.cli as cli
+    from nerrf_tpu.tune import load_kernel_bench_crossover
+
+    monkeypatch.chdir(repo_root)  # the CLI's default --kernel-bench path
+    corpus = tmp_path / "corpus.json"
+    corpus.write_text(json.dumps(golden_corpus()))
+    out = tmp_path / "tuned.json"
+    assert cli.main(["tune", str(corpus), "--out", str(out)]) == 0
+    art = load_artifact(out)
+    validate_artifact(art)
+    kb = load_kernel_bench_crossover(
+        "benchmarks/results/kernel_bench_cpu.json")
+    assert kb is not None  # the checked-in artifact carries the crossover
+    assert art == tune(golden_corpus(), kernel_bench=kb)
+
+
+# -- artifact contract --------------------------------------------------------
+
+
+def test_artifact_roundtrip_and_validation(tmp_path):
+    art = tune(golden_corpus())
+    path = tmp_path / "tuned.json"
+    save_artifact(path, art)
+    assert load_artifact(path) == art
+
+    with pytest.raises(TuneError):
+        load_artifact(tmp_path / "missing.json")
+    with pytest.raises(TuneError, match="kind"):
+        validate_artifact(dict(art, kind="other"))
+    with pytest.raises(TuneError, match="schema"):
+        validate_artifact(dict(art, schema=ARTIFACT_SCHEMA + 1))
+    with pytest.raises(TuneError):
+        validate_artifact(dict(art, buckets=[]))
+    with pytest.raises(TuneError):
+        validate_artifact(dict(art, routing=[[512, "nonsense_mode"]]))
+
+
+def test_artifact_applies_to_serve_and_model_config():
+    from nerrf_tpu.models import JointConfig
+    from nerrf_tpu.serve import ServeConfig
+
+    art = tune(golden_corpus())
+    cfg = apply_to_serve_config(art, ServeConfig(batch_size=4))
+    assert cfg.batch_size == 4  # only the ladder is replaced
+    assert [list(b) for b in cfg.buckets] == art["buckets"]
+
+    joint = apply_to_model_config(art, JointConfig().small)
+    assert joint.gnn.routing == tuple(
+        (cap, mode) for cap, mode in art["routing"])
+    # routing rides the model repr into serve program cache keys: a
+    # tuned boot can never collide with an untuned executable
+    from nerrf_tpu.compilecache.aot import serve_program_key
+    assert (serve_program_key(joint, "512n/1024e/32s")
+            != serve_program_key(JointConfig().small, "512n/1024e/32s"))
+
+
+def test_routing_table_overrides_global_constant():
+    from nerrf_tpu.models.graphsage import GraphSAGEConfig
+
+    cfg = GraphSAGEConfig(routing=((512, "dense_adj"), (4096, "fused")))
+    assert cfg.resolved_aggregation(300) == "dense_adj"
+    assert cfg.resolved_aggregation(2000) == "fused"
+    with pytest.raises(ValueError):
+        GraphSAGEConfig(routing=((512, "not_a_mode"),))
+
+
+# -- the tuned ladder through the deployment contracts ------------------------
+
+
+@pytest.fixture(scope="module")
+def tuned_serve_cfg():
+    return apply_to_serve_config(tune(golden_corpus()))
+
+
+def test_tuned_rungs_are_pallas_budget_clean(tuned_serve_cfg):
+    """Every tuned rung clears the same per-core VMEM audit `nerrf lint
+    --deep` enforces — the search's budget gate is the lint's, so this
+    can only fail if they drift apart."""
+    from nerrf_tpu.analysis.programs.pallas_budget import PallasBudget
+    from nerrf_tpu.graph.builder import NODE_FEATURE_DIM
+    from nerrf_tpu.models.graphsage import GraphSAGEConfig
+    from nerrf_tpu.ops.pallas_segment import kernel_vmem_blocks
+
+    width = max(GraphSAGEConfig().hidden, NODE_FEATURE_DIM)
+    for n, e, _s in tuned_serve_cfg.buckets:
+        findings = PallasBudget().audit(kernel_vmem_blocks(n, e, width),
+                                        shape=(n, e, width))
+        assert findings == [], f"rung {n}n/{e}e over VMEM budget"
+
+
+def test_tuned_ladder_passes_program_closure(repo_root):
+    """The admission/warmup/program-closure contract holds unchanged on
+    a tuned ladder: every tuned rung is warmup-reachable and every
+    admission signature is inside the warmup-compiled set."""
+    from nerrf_tpu.analysis.astutil import Project, collect_files
+    from nerrf_tpu.analysis.programs.closure import SignatureClosure
+
+    project = Project(repo_root, collect_files(repo_root, ("nerrf_tpu",)))
+    cfg = apply_to_serve_config(tune(golden_corpus()))
+    found = SignatureClosure(serve_cfg=cfg, trace_extremes=False).run(project)
+    assert found == []
+
+
+# -- corpus plumbing (satellite: rejected-window recording) -------------------
+
+
+def test_rejected_windows_flow_into_corpus_and_demand(tmp_path):
+    """Admission-rejected window sizes reach the corpus as their own
+    distribution (satellite 1) and the search's demand includes them —
+    demand beyond the top rung is what pulls a ladder up."""
+    from nerrf_tpu.archive import ArchiveConfig, ArchiveWriter, export_tune
+
+    w = ArchiveWriter(ArchiveConfig(out_dir=str(tmp_path / "arch")))
+    for _ in range(4):
+        w.observe_window("1024n/2048e/128s", nodes=300, edges=600, files=20,
+                         stages={"device": 0.01}, e2e_sec=0.05)
+    w.observe_rejected(nodes=9000, edges=20000, files=600)
+    w.close()
+    corpus = export_tune(str(tmp_path / "arch"))
+    assert corpus["windows_rejected"] == 1
+    assert corpus["rejected_window_size_distribution"] is not None
+    points = demand_points(corpus)
+    assert any(p.nodes > 4096 for p in points)
+
+
+def test_build_artifact_fingerprints_corpus():
+    c = golden_corpus()
+    a = build_artifact(((256, 512, 64),), ((256, "fused"),),
+                       {"improvement": 0.0}, {}, corpus=c)
+    b = build_artifact(((256, 512, 64),), ((256, "fused"),),
+                       {"improvement": 0.0}, {},
+                       corpus=dict(c, windows_observed=101))
+    assert a["corpus_fingerprint"] != b["corpus_fingerprint"]
+    validate_artifact(a)
+
+
+def test_aot_export_stamps_tuned_manifest(tmp_path):
+    """`export_executables` records the tuned stamp in the manifest so
+    an AOT cache dir self-describes which artifact produced it."""
+    from nerrf_tpu.compilecache import aot
+
+    stamp = {"corpus_fingerprint": "abc123", "routing": [[512, "fused"]]}
+    art = tune(golden_corpus())
+    assert art["corpus_fingerprint"]
+    # manifest plumbing only — no compile: exercised via the helper that
+    # assembles the manifest dict if exposed, else via signature presence
+    import inspect
+    assert "tuned_stamp" in inspect.signature(
+        aot.export_executables).parameters
+    assert "tuned" in inspect.signature(
+        aot.export_for_checkpoint).parameters
