@@ -759,13 +759,48 @@ def main() -> None:
         return _smoke_or_artifact("tune", "run_tune_bench.py",
                                   "tune_bench_cpu.json", surface)
 
+    def _fleet():
+        # fleet control plane: headroom-led autoscale, slot-map
+        # rebalance, SLO-ranked shedding, warm replica boots, and the
+        # archive-compare regression gate (docs/fleet.md)
+        def surface(r):
+            auto = r.get("autoscale") or {}
+            shed = r.get("shed") or {}
+            return {
+                "scale_out_lead_streams": r.get("value"),
+                "streams_at_scale_out": auto.get("streams_at_scale_out"),
+                "measured_saturation_streams": auto.get("k_star"),
+                "scale_in_on_slack": auto.get("scale_in"),
+                "rebalance_moved": auto.get("rebalance_moved"),
+                "shed_victims": shed.get("victims"),
+                "shed_ranking_topped_by_burner":
+                    shed.get("ranking_all_topped_by_burner"),
+                "healthy_windows_scored":
+                    shed.get("healthy_windows_scored"),
+                "warm_boot_parity": {
+                    name: (w or {}).get(
+                        "parity_bit_identical_to_model_detect")
+                    for name, w in (r.get("warmboot") or {}).items()
+                },
+                "compare_gate_rcs": r.get("compare_gate"),
+                "recompiles_after_warmup":
+                    r.get("recompiles_after_warmup"),
+                "backend": r.get("backend"),
+                "smoke": r.get("smoke"),
+                "provenance": r.get("provenance"),
+            }
+
+        return _smoke_or_artifact("fleet", "run_fleet_bench.py",
+                                  "fleet_bench_cpu.json", surface)
+
     # per-artifact isolation: one truncated/corrupt JSON on disk must not
     # silently drop the valid artifacts after it
     for key, loader in (("corpus100h", _j100), ("adversarial", _adv),
                         ("m1_recovery", _recovery), ("tracker", _tracker),
                         ("serve", _serve), ("model_swap", _swap),
                         ("chaos", _chaos), ("quality", _quality),
-                        ("train_health", _train_health), ("tune", _tune)):
+                        ("train_health", _train_health), ("tune", _tune),
+                        ("fleet", _fleet)):
         try:
             entry = loader()
             if entry is not None:

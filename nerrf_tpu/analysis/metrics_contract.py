@@ -112,6 +112,12 @@ REQUIRED = (
     "archive_bytes_total",
     "archive_dropped_total",
     "archive_writer_lag_seconds",
+    # the fleet control plane (docs/fleet.md; the autoscaling runbook and
+    # run_fleet_bench's gates key off these exact names)
+    "fleet_replicas",
+    "fleet_headroom_streams",
+    "fleet_rebalances_total",
+    "fleet_shed_total",
 )
 
 _CALL = re.compile(

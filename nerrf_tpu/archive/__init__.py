@@ -30,6 +30,7 @@ from nerrf_tpu.archive.writer import (  # noqa: F401
     ArchiveWriter,
 )
 from nerrf_tpu.archive.report import (  # noqa: F401
+    CompareConfig,
     build_report,
     compare_reports,
     export_tune,

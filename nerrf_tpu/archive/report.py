@@ -21,20 +21,41 @@ production workload distribution).
 
 from __future__ import annotations
 
+import dataclasses
 import json
 import re
 from typing import Dict, List, Optional, Tuple
 
 from nerrf_tpu.archive.spool import iter_records, list_segments
 
-#: compare_reports thresholds: (ratio regressions fire past ×R, rate
-#: regressions past +abs).  Deliberately loose — a cross-run diff on a
-#: noisy CPU rig must flag real regressions, not scheduler jitter.
+#: compare_reports default thresholds: (ratio regressions fire past ×R,
+#: rate regressions past +abs).  Deliberately loose — a cross-run diff
+#: on a noisy CPU rig must flag real regressions, not scheduler jitter.
+#: Kept as module constants for callers that want the defaults by name;
+#: `CompareConfig` is the tunable form (`nerrf report --compare` flags).
 P99_REGRESSION_RATIO = 1.5
 COST_REGRESSION_RATIO = 1.5
 LOSS_REGRESSION_RATIO = 1.25
 RATE_REGRESSION_ABS = 0.02
 PSI_BREACH = 0.25
+
+
+@dataclasses.dataclass(frozen=True)
+class CompareConfig:
+    """Tolerance knobs for `compare_reports` — one field per regression
+    class, CLI-settable (`--p99-ratio` etc.) so a queue's gate can be
+    tightened or loosened without editing code.  The thresholds used are
+    stamped into the comparison output, so a gate failure names the bar
+    it was judged against."""
+
+    p99_ratio: float = P99_REGRESSION_RATIO      # e2e p99 ×R
+    cost_ratio: float = COST_REGRESSION_RATIO    # device s/batch ×R
+    loss_ratio: float = LOSS_REGRESSION_RATIO    # final train loss ×R
+    rate_abs: float = RATE_REGRESSION_ABS        # breach/drop rate +abs
+    psi_breach: float = PSI_BREACH               # score-drift PSI bar
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
 
 _NAME_TAG = re.compile(r"^([a-z_]+)\[(.+)\]$")
 
@@ -379,10 +400,14 @@ def format_report(report: dict) -> str:
 # -- cross-run regression diff ------------------------------------------------
 
 
-def compare_reports(a: dict, b: dict) -> dict:
+def compare_reports(a: dict, b: dict,
+                    cfg: Optional[CompareConfig] = None) -> dict:
     """Diff run B against baseline run A; every flagged regression is one
     dict with what/baseline/candidate — the `--compare` CI gate fails on
-    a non-empty list."""
+    a non-empty list.  The thresholds actually applied (``cfg``, default
+    `CompareConfig()`) are stamped into the result so the verdict is
+    self-describing."""
+    cfg = cfg or CompareConfig()
     regressions: List[dict] = []
 
     def flag(what: str, base, cand) -> None:
@@ -391,19 +416,19 @@ def compare_reports(a: dict, b: dict) -> dict:
 
     pa = ((a["slo"].get("e2e_ms") or {}).get("p99"))
     pb = ((b["slo"].get("e2e_ms") or {}).get("p99"))
-    if pa and pb and pb > pa * P99_REGRESSION_RATIO:
+    if pa and pb and pb > pa * cfg.p99_ratio:
         flag(f"e2e p99 regressed ×{pb / pa:.2f} "
-             f"(threshold ×{P99_REGRESSION_RATIO:g})", pa, pb)
+             f"(threshold ×{cfg.p99_ratio:g})", pa, pb)
     ra = a["slo"].get("breach_rate") or 0.0
     rb = b["slo"].get("breach_rate") or 0.0
-    if rb > ra + RATE_REGRESSION_ABS:
+    if rb > ra + cfg.rate_abs:
         flag("SLO breach rate regressed", ra, rb)
 
     drops_a = sum((a["incidents"].get("drops") or {}).values())
     drops_b = sum((b["incidents"].get("drops") or {}).values())
     wa = max(a["slo"].get("windows_scored") or 0, 1)
     wb = max(b["slo"].get("windows_scored") or 0, 1)
-    if drops_b / wb > drops_a / wa + RATE_REGRESSION_ABS:
+    if drops_b / wb > drops_a / wa + cfg.rate_abs:
         flag("window drop rate regressed",
              round(drops_a / wa, 4), round(drops_b / wb, 4))
 
@@ -412,30 +437,35 @@ def compare_reports(a: dict, b: dict) -> dict:
     for tag in sorted(set(progs_a) & set(progs_b)):
         ca = progs_a[tag].get("device_seconds_mean")
         cb = progs_b[tag].get("device_seconds_mean")
-        if ca and cb and cb > ca * COST_REGRESSION_RATIO:
+        if ca and cb and cb > ca * cfg.cost_ratio:
             flag(f"device seconds per batch regressed ×{cb / ca:.2f} "
                  f"on {tag}", ca, cb)
 
     psi_a = a["drift"].get("worst_score_psi") or 0.0
     psi_b = b["drift"].get("worst_score_psi") or 0.0
-    if psi_b >= PSI_BREACH > psi_a:
-        flag(f"score drift crossed the {PSI_BREACH:g} PSI breach",
+    if psi_b >= cfg.psi_breach > psi_a:
+        flag(f"score drift crossed the {cfg.psi_breach:g} PSI breach",
              psi_a, psi_b)
 
     la = (a["train"].get("last") or {}).get("loss")
     lb = (b["train"].get("last") or {}).get("loss")
-    if la and lb and lb > la * LOSS_REGRESSION_RATIO:
+    if la and lb and lb > la * cfg.loss_ratio:
         flag(f"final train loss regressed ×{lb / la:.2f}", la, lb)
     if b["train"].get("halted") and not a["train"].get("halted"):
         flag("training halted in candidate", None, b["train"]["halted"])
 
     return {"baseline": a["span"]["dirs"], "candidate": b["span"]["dirs"],
+            "thresholds": cfg.to_dict(),
             "regressions": regressions, "ok": not regressions}
 
 
 def format_compare(cmp: dict) -> str:
     lines = [f"compare: baseline {', '.join(cmp['baseline'])} vs "
              f"candidate {', '.join(cmp['candidate'])}"]
+    th = cmp.get("thresholds")
+    if th:
+        lines.append("  thresholds: " + " ".join(
+            f"{k}={v:g}" for k, v in sorted(th.items())))
     if cmp["ok"]:
         lines.append("  no regressions flagged")
     for r in cmp["regressions"]:
@@ -506,18 +536,42 @@ def export_tune(paths, since: Optional[float] = None,
 
 
 def report_main(paths, since=None, until=None, compare=None,
-                as_json=False, out=print) -> int:
+                as_json=False, out=print, gate=False,
+                compare_cfg: Optional[CompareConfig] = None) -> int:
     """The `nerrf report` body; returns a CLI exit code (compare mode:
-    1 when a regression is flagged)."""
+    1 when a regression is flagged).
+
+    ``gate=True`` is the continuous-regression form (`--gate`): the same
+    compare verdict framed for queue pre-flights — a one-line GATE
+    PASS/FAIL verdict, and a *missing or empty baseline* passes with a
+    note instead of erroring, so the first run before an
+    artifact-of-record is banked doesn't hard-fail the queue."""
     from nerrf_tpu.flight.journal import SchemaVersionError
 
     try:
         if compare:
-            a = build_report([compare[0]], since=since, until=until)
+            if gate:
+                try:
+                    a = build_report([compare[0]], since=since,
+                                     until=until)
+                except (FileNotFoundError, SchemaVersionError) as e:
+                    out(f"GATE PASS (no banked baseline at "
+                        f"{compare[0]}: {e})")
+                    return 0
+                if not a["span"]["records"]:
+                    out(f"GATE PASS (baseline {compare[0]} holds no "
+                        f"records in range — nothing banked yet)")
+                    return 0
+            else:
+                a = build_report([compare[0]], since=since, until=until)
             b = build_report([compare[1]], since=since, until=until)
-            cmp = compare_reports(a, b)
+            cmp = compare_reports(a, b, cfg=compare_cfg)
             out(json.dumps(cmp, indent=2) if as_json else
                 format_compare(cmp))
+            if gate:
+                out("GATE PASS" if cmp["ok"] else
+                    "GATE FAIL: " + "; ".join(
+                        r["what"] for r in cmp["regressions"]))
             return 0 if cmp["ok"] else 1
         report = build_report(paths, since=since, until=until)
         out(json.dumps(report, indent=2) if as_json else

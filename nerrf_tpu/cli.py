@@ -1528,11 +1528,20 @@ def cmd_report(args) -> int:
     """Offline fleet report over archived telemetry (docs/archive.md):
     SLO conformance, capacity headroom, drift, device efficiency and
     training health from segments alone — or, with --compare, a
-    cross-run regression diff that exits 1 when the candidate regressed."""
-    from nerrf_tpu.archive import report_main
+    cross-run regression diff that exits 1 when the candidate regressed.
+    --gate frames the diff as a queue pre-flight: one-line PASS/FAIL
+    verdict, and a missing baseline passes with a note (first run before
+    an artifact-of-record is banked)."""
+    from nerrf_tpu.archive import CompareConfig, report_main
 
+    cfg = CompareConfig(p99_ratio=args.p99_ratio,
+                        cost_ratio=args.cost_ratio,
+                        loss_ratio=args.loss_ratio,
+                        rate_abs=args.rate_abs,
+                        psi_breach=args.psi_breach)
     return report_main(args.dir, since=args.since, until=args.until,
-                       compare=args.compare, as_json=args.json)
+                       compare=args.compare, as_json=args.json,
+                       gate=args.gate, compare_cfg=cfg)
 
 
 def cmd_doctor(args) -> int:
@@ -2092,6 +2101,32 @@ def main(argv=None) -> int:
                    help="diff two archive dirs and exit 1 when the "
                         "candidate regressed (p99, breach/drop rate, "
                         "per-bucket device cost, drift, train loss)")
+    p.add_argument("--gate", action="store_true",
+                   help="continuous-regression framing for --compare: "
+                        "one-line GATE PASS/FAIL verdict, and a missing "
+                        "baseline passes with a note (first run before "
+                        "an artifact-of-record is banked)")
+    from nerrf_tpu.archive.report import CompareConfig as _CmpCfg
+    p.add_argument("--p99-ratio", type=float,
+                   default=_CmpCfg.p99_ratio, metavar="R",
+                   help="flag when candidate e2e p99 > baseline ×R "
+                        "(default %(default)s)")
+    p.add_argument("--cost-ratio", type=float,
+                   default=_CmpCfg.cost_ratio, metavar="R",
+                   help="flag when per-bucket device seconds/batch > "
+                        "baseline ×R (default %(default)s)")
+    p.add_argument("--loss-ratio", type=float,
+                   default=_CmpCfg.loss_ratio, metavar="R",
+                   help="flag when final train loss > baseline ×R "
+                        "(default %(default)s)")
+    p.add_argument("--rate-abs", type=float,
+                   default=_CmpCfg.rate_abs, metavar="A",
+                   help="flag when breach/drop rate > baseline +A "
+                        "(default %(default)s)")
+    p.add_argument("--psi-breach", type=float,
+                   default=_CmpCfg.psi_breach, metavar="P",
+                   help="flag when score-drift PSI crosses P in the "
+                        "candidate only (default %(default)s)")
     p.add_argument("--since", type=float, default=None, metavar="UNIX",
                    help="only records at/after this unix timestamp")
     p.add_argument("--until", type=float, default=None, metavar="UNIX",

@@ -26,6 +26,10 @@ process, no scrape history.  The report has four sections:
      triggers, the manifest context's loss tail and last-good-checkpoint
      restart pointer (docs/training-health.md).  Serve-side bundles
      degrade to one line.
+  8. fleet — the controller's decision tail (``fleet_scale`` /
+     ``fleet_rebalance`` / ``fleet_shed`` journal records) with the
+     per-replica headroom evidence each scale decision carried
+     (docs/fleet.md).  Single-replica bundles degrade to one line.
 
 Unreadable pieces degrade per-section (a bundle written mid-crash may
 lack a file) — partial evidence beats no report.
@@ -251,6 +255,9 @@ def format_report(bundle: dict, tail: Optional[int] = None) -> str:
 
     lines.append("")
     lines.extend(train_section(bundle))
+
+    lines.append("")
+    lines.extend(fleet_section(bundle))
     return "\n".join(lines)
 
 
@@ -312,6 +319,55 @@ def train_section(bundle: dict) -> List[str]:
     done = next((r for r in records if r.kind == "train_done"), None)
     if done is not None and done.data.get("halted"):
         lines.append(f"  halted: {done.data['halted']}")
+    return lines
+
+
+#: journal kinds the fleet section reads
+FLEET_KINDS = ("fleet_scale", "fleet_rebalance", "fleet_shed")
+
+
+def fleet_section(bundle: dict) -> List[str]:
+    """The fleet-control report over a bundle's journal tail
+    (docs/fleet.md): the controller's decision tail plus the per-replica
+    headroom evidence the latest scale decision carried.  Degrades to
+    one line on single-replica bundles — most pods never see a fleet
+    decision, and an empty table would read as a broken controller."""
+    records = [r for r in bundle.get("records", [])
+               if r.kind in FLEET_KINDS]
+    if not records:
+        return ["fleet: no fleet records in bundle (single-replica pod, "
+                "or the run predates the fleet control plane)"]
+    lines = [f"fleet (controller decision tail, {len(records)} records):"]
+    for r in records[-10:]:
+        d = r.data
+        if r.kind == "fleet_scale":
+            ev = d.get("evidence") or {}
+            lines.append(
+                f"  scale {d.get('direction', '-'):<4} "
+                f"{d.get('replica', '-'):<8} "
+                f"{d.get('replicas_before', '-')}→"
+                f"{d.get('replicas_after', '-')} replicas  "
+                f"reason={d.get('reason', '-')} "
+                f"worst_headroom={_num(ev.get('worst_headroom_streams'))}")
+        elif r.kind == "fleet_rebalance":
+            moved = d.get("moved") or []
+            lines.append(
+                f"  rebalance: {len(moved)} stream(s) moved "
+                f"({_compact(moved)}) across "
+                f"{len(d.get('replicas') or [])} replicas")
+        else:  # fleet_shed
+            lines.append(
+                f"  shed {d.get('victim', r.stream) or '-'}: "
+                f"burn={_num(d.get('burn_ratio'))} "
+                f"reason={d.get('reason', '-')}")
+    latest = next((r for r in reversed(records)
+                   if r.kind == "fleet_scale"), None)
+    per = ((latest.data.get("evidence") or {}).get("per_replica")
+           if latest else None)
+    if per:
+        lines.append("  per-replica headroom at last scale decision: "
+                     + " ".join(f"{k}={_num(v)}"
+                                for k, v in sorted(per.items())))
     return lines
 
 

@@ -41,6 +41,13 @@ Record kinds in use (producers in parentheses):
                       ratio, throughput, data-wait fraction, nonfinite
                       flags (trainwatch/monitor; the train_divergence /
                       train_starvation / train_stall trigger evidence)
+    fleet_scale       controller scaled the replica set out/in, with the
+                      headroom evidence that justified it (fleet/controller)
+    fleet_rebalance   stream slots remapped across replicas via the
+                      deterministic slot map (fleet/controller)
+    fleet_shed        admission shed a budget-burning stream's window
+                      under pressure, with the burn ranking snapshot
+                      (serve/service; fleet/controller)
     exception         uncaught exception captured by the crash hook
     bundle            a flight-recorder bundle was written (flight/recorder)
 
@@ -86,7 +93,8 @@ KNOWN_KINDS = (
     "registry_shadow_stats", "quality_reference", "quality_stats",
     "capacity_saturation", "compile", "compile_cache_prune",
     "profile_capture", "profile_failed", "train_start", "train_done",
-    "train_health", "exception", "bundle",
+    "train_health", "fleet_scale", "fleet_rebalance", "fleet_shed",
+    "exception", "bundle",
 )
 
 

@@ -103,6 +103,26 @@ class ServeConfig:
     # serving (null-not-fake); False drops the plane for minimal
     # embedders
     quality_monitoring: bool = True
+    # SLO-aware shedding (docs/fleet.md): when a stream's bounded queue
+    # overflows AND the capacity-headroom predictor says the whole
+    # service is under pressure (headroom below shed_headroom_margin),
+    # the victim window comes from the stream currently burning the most
+    # SLO budget (trailing slo_budget_burn_ratio, flight/slo) instead of
+    # from the admitting stream — budget-burners lose evidence first,
+    # healthy streams keep bit-parity.  Drop-oldest stays as the
+    # intra-stream bound (and as the whole policy when this is False or
+    # headroom shows slack: a single stream overrunning its own queue in
+    # an otherwise idle fleet is its own problem, not its neighbors')
+    slo_aware_shedding: bool = True
+    # predicted headroom (in streams) below which shedding goes
+    # SLO-ranked; requires devtime_accounting (the headroom predictor)
+    shed_headroom_margin: float = 1.0
+    # trailing window of the devtime accountant's rate/cost/utilization
+    # state (seconds).  The headroom prediction follows traffic shifts at
+    # this horizon: production keeps the steady 60s default, while paced
+    # soaks (benchmarks/run_fleet_bench.py) shrink it so scale-in slack
+    # registers within the bench's wall clock
+    devtime_window_sec: float = 60.0
     # device-efficiency plane (nerrf_tpu/devtime): live per-program MFU /
     # utilization / useful-FLOPs gauges and the capacity-headroom
     # predictor, fed from the scorer's measured device seconds.  Host-side

@@ -182,8 +182,9 @@ class OnlineDetectionService:
         # device seconds + the analytic cost model registered at warmup,
         # and the capacity-headroom predictor over the admit stream.
         # Chip-relative gauges stay absent on CPU (null-not-fake)
-        self._devtime = (DeviceTimeAccountant(registry=registry,
-                                              journal=self._journal)
+        self._devtime = (DeviceTimeAccountant(
+                             registry=registry, journal=self._journal,
+                             window_sec=self.cfg.devtime_window_sec)
                          if self.cfg.devtime_accounting else None)
         # detection-quality plane (nerrf_tpu/quality): trailing
         # score/feature drift sketches vs the live version's reference
@@ -778,6 +779,92 @@ class OnlineDetectionService:
             except KeyError:
                 raise KeyError(f"stream {stream_id!r} not joined") from None
 
+    # -- SLO-aware shedding (docs/fleet.md) -----------------------------------
+
+    def _shed_pressure(self) -> bool:
+        """True when the capacity-headroom predictor says the whole
+        service is under pressure (predicted headroom below the shed
+        margin) — the gate that turns a per-stream queue overflow into
+        a fleet-ranked shed instead of a private drop-oldest."""
+        if not self.cfg.slo_aware_shedding or self._devtime is None:
+            return False
+        est = self._devtime.last_estimate
+        return est is not None and \
+            est.headroom_streams < self.cfg.shed_headroom_margin
+
+    def _select_shed_victim(self, base: str):
+        """The stream that loses evidence under pressure: worst trailing
+        DEVICE-stage SLO budget burn (flight/slo stage accounting) among
+        non-quarantined streams burning MORE than the admitting one —
+        healthy streams keep bit-parity, and when the admitting stream
+        is itself the worst burner the answer is None (its own
+        drop-oldest bound already sheds the right victim).  Returns
+        ``(handle, burn_ratio, ranking)`` or None.
+
+        Device stage, NOT the summed total: on a saturated shared FIFO
+        every cohabitant's total burn converges to the deadline — their
+        latency is set by the queue they all share, so the total cannot
+        separate the stream CAUSING the pressure from the streams
+        suffering it (measured in benchmarks/run_fleet_bench.py part C:
+        burner 0.99 vs healthy 0.98 total, 0.43 vs 0.11 device).  The
+        device stage is the occupancy a stream's own windows impose on
+        the fleet, and it separates cause from victim by construction."""
+        snap = self._slo.snapshot()
+        burns: Dict[str, float] = {}
+        for s, ent in (snap.get("per_stream") or {}).items():
+            burn = (ent.get("budget_burn") or {}).get("device", 0.0)
+            b = _base_stream(s)
+            burns[b] = max(burns.get(b, 0.0), burn)
+        own = burns.get(base, 0.0)
+        with self._lock:
+            quarantined = set(self._quarantined)
+            by_base: Dict[str, List[StreamHandle]] = {}
+            for h in self._streams.values():
+                by_base.setdefault(_base_stream(h.id), []).append(h)
+        ranking = sorted(((b, round(r, 4)) for b, r in burns.items()
+                          if r > 0), key=lambda kv: kv[1], reverse=True)
+        for b, r in ranking:
+            if r <= own:
+                break  # sorted: nobody below burns more than us
+            if b == base or b in quarantined:
+                continue
+            for h in by_base.get(b, ()):
+                if h.live:  # racy hint; _shed_one recheck under cond
+                    return h, r, ranking
+        return None
+
+    def _shed_one(self, base: str) -> Optional[dict]:
+        """Drop the worst budget-burner's OLDEST queued window (the
+        intra-stream bound survives inside the victim) and return the
+        evidence for the fleet_shed record, or None when no ranked
+        victim exists.  The victim's cond is taken and released here —
+        never nested with the admitting stream's."""
+        picked = self._select_shed_victim(base)
+        if picked is None:
+            return None
+        vhandle, burn, ranking = picked
+        with vhandle.cond:
+            for old_idx, old in vhandle.live.items():
+                if self._batcher.mark_dropped(old):
+                    del vhandle.live[old_idx]
+                    vhandle.dropped += 1
+                    self._reg.counter_inc(
+                        "serve_admission_dropped_total",
+                        labels={"reason": "shed"},
+                        help="windows dropped at the serve admission "
+                             "boundary")
+                    self._reg.counter_inc(
+                        "fleet_shed_total",
+                        labels={"stream": _base_stream(vhandle.id),
+                                "reason": "budget_burn"},
+                        help="windows shed from SLO-budget-burning "
+                             "streams under capacity pressure "
+                             "(docs/fleet.md)")
+                    return {"victim": vhandle.id, "window_id": old_idx,
+                            "trace_id": old.trace_id,
+                            "burn_ratio": burn, "ranking": ranking}
+        return None
+
     def _admit(self, handle: StreamHandle, idx: int, lo: int, hi: int) -> None:
         trace_id = make_trace_id(handle.id, idx, lo)
         with trace_span("serve_admit", stream=handle.id, window=idx,
@@ -883,9 +970,22 @@ class OnlineDetectionService:
                 deadline=now + self.cfg.window_deadline_sec,
                 trace_id=trace_id,
                 nodes=int(n), edges=int(e), files=int(files))
+            shed = None
+            if len(handle.live) >= self.cfg.stream_queue_slots \
+                    and self._shed_pressure():
+                # SLO-aware shed: under fleet-wide pressure the victim
+                # is the worst budget-burner's oldest window, not this
+                # stream's — sheds BEFORE handle.cond is taken so the
+                # two streams' conds are never nested
+                shed = self._shed_one(base)
+            # when another stream paid, this stream's queue may stretch
+            # to 2x slots before its own drop-oldest bound applies —
+            # still hard-bounded memory, but a healthy stream is not
+            # robbed to admit its own next window while burners queue
+            allowed = self.cfg.stream_queue_slots * (2 if shed else 1)
             dropped_old = None
             with handle.cond:
-                if len(handle.live) >= self.cfg.stream_queue_slots:
+                if len(handle.live) >= allowed:
                     # drop-OLDEST: under sustained overload the newest
                     # evidence wins (the oldest window is the least
                     # actionable); only still-queued requests are droppable
@@ -902,6 +1002,20 @@ class OnlineDetectionService:
                             break
                 handle.live[idx] = req
                 handle.admitted += 1
+            if shed is not None:
+                # journal OUTSIDE every cond (see dropped_old below);
+                # admission_drop keeps the drop inventory uniform, the
+                # fleet_shed record carries the ranking evidence
+                self._journal.record(
+                    "admission_drop", stream=shed["victim"],
+                    window_id=shed["window_id"],
+                    trace_id=shed["trace_id"], reason="shed")
+                self._journal.record(
+                    "fleet_shed", stream=shed["victim"],
+                    window_id=shed["window_id"],
+                    trace_id=shed["trace_id"], reason="budget_burn",
+                    burn_ratio=shed["burn_ratio"],
+                    ranking=shed["ranking"], admitting=handle.id)
             if dropped_old is not None:
                 # journal OUTSIDE handle.cond: listeners (the flight
                 # recorder) may dump a bundle on this record, and the

@@ -39,7 +39,7 @@ ORDER = ["index", "quick-start", "architecture", "models", "kernel-paths",
          "planner", "rollback", "ingest", "scaling", "configuration",
          "serving", "model-lifecycle", "compile-cache", "operations",
          "device-efficiency", "flight-recorder", "quality",
-         "training-health", "archive", "tuning", "chaos",
+         "training-health", "archive", "tuning", "fleet", "chaos",
          "static-analysis", "benchmarks"]
 
 _CSS = """

@@ -144,6 +144,23 @@ print(f"e2e: tuned-ladder boot scores {int(r['windows_scored'])} windows, "
       "zero post-warmup recompiles")
 EOF
 
+# pre-flight: archive-compare regression gate — the fresh archived smoke
+# run above vs this host's banked artifact-of-record (docs/fleet.md).
+# `nerrf report --compare --gate` exits nonzero when the candidate
+# regressed beyond the CompareConfig tolerances (e2e p99, breach/drop
+# rate, per-bucket device cost, drift, train loss), failing the run
+# BEFORE any chip time; a missing bank (first run on a host) passes with
+# a note, and a green gate re-banks the current run so every later run
+# is measured against the best-known-good.  Pinned to CPU: the compare
+# is pure arithmetic over the segments.
+BASELINE="${NERRF_ARCHIVE_BASELINE:-$HOME/.cache/nerrf/archive_baseline}"
+timeout 120 env JAX_PLATFORMS=cpu python -m nerrf_tpu.cli report \
+    --compare "$BASELINE" "$WORK/archive" --gate
+mkdir -p "$(dirname "$BASELINE")"
+rm -rf "$BASELINE"
+cp -r "$WORK/archive" "$BASELINE"
+echo "e2e: archive-compare gate green (artifact-of-record banked at $BASELINE)"
+
 # pre-flight: devtime smoke — the device-efficiency cost table (analytic
 # FLOPs / byte floor / roofline intensity for the serve ladder + flat
 # train step) resolves on CPU with every chip-relative column null

@@ -119,8 +119,26 @@ then
   log "PRE-FLIGHT FAIL: tuned-ladder boot gates (/tmp/tuned_serve.json)"
   exit 1
 fi
-rm -rf /tmp/archive_smoke
 log "pre-flight: tuned-ladder boot scores windows, zero post-warmup recompiles"
+# pre-flight: archive-compare regression gate on CPU — the archived
+# smoke run above vs this host's banked artifact-of-record
+# (docs/fleet.md).  `report --compare --gate` exits nonzero when the
+# candidate regressed beyond the CompareConfig tolerances, failing the
+# queue BEFORE any tunnel time; a missing bank (first run on a host)
+# passes with a note, and a green gate re-banks the run so every later
+# queue run is measured against the best-known-good
+BASELINE="${NERRF_ARCHIVE_BASELINE:-/var/tmp/nerrf_archive_baseline}"
+if ! timeout 120 env JAX_PLATFORMS=cpu python -m nerrf_tpu.cli report \
+  --compare "$BASELINE" /tmp/archive_smoke --gate >> /tmp/tpu_queue.log 2>&1
+then
+  log "PRE-FLIGHT FAIL: archive-compare gate vs $BASELINE (/tmp/tpu_queue.log)"
+  exit 1
+fi
+mkdir -p "$(dirname "$BASELINE")"
+rm -rf "$BASELINE"
+cp -r /tmp/archive_smoke "$BASELINE"
+rm -rf /tmp/archive_smoke
+log "pre-flight: archive-compare gate green (banked at $BASELINE)"
 # pre-flight: devtime cost table on CPU — the analytic cost model must
 # resolve for the whole serve ladder + train step with every
 # chip-relative column null (docs/device-efficiency.md); fails in

@@ -98,8 +98,22 @@ then
   log "PRE-FLIGHT FAIL: tuned-ladder boot gates (/tmp/tuned_serve.json)"
   exit 1
 fi
-rm -rf /tmp/archive_smoke
 log "pre-flight: tuned-ladder boot scores windows, zero post-warmup recompiles"
+# same archive-compare gate as tpu_queue.sh: the archived smoke run vs
+# this host's banked artifact-of-record; regression fails the queue
+# before tunnel time, a green gate re-banks the run (docs/fleet.md)
+BASELINE="${NERRF_ARCHIVE_BASELINE:-/var/tmp/nerrf_archive_baseline}"
+if ! timeout 120 env JAX_PLATFORMS=cpu python -m nerrf_tpu.cli report \
+  --compare "$BASELINE" /tmp/archive_smoke --gate >> /tmp/tpu_queue.log 2>&1
+then
+  log "PRE-FLIGHT FAIL: archive-compare gate vs $BASELINE (/tmp/tpu_queue.log)"
+  exit 1
+fi
+mkdir -p "$(dirname "$BASELINE")"
+rm -rf "$BASELINE"
+cp -r /tmp/archive_smoke "$BASELINE"
+rm -rf /tmp/archive_smoke
+log "pre-flight: archive-compare gate green (banked at $BASELINE)"
 # same devtime pre-flight as tpu_queue.sh: the cost table must resolve
 # on CPU with chip-relative columns null (docs/device-efficiency.md)
 if ! timeout 300 env JAX_PLATFORMS=cpu python -m nerrf_tpu.cli profile costs \

@@ -11,6 +11,7 @@ from nerrf_tpu.archive import (
     ArchiveConfig,
     ArchiveSpool,
     ArchiveWriter,
+    CompareConfig,
     SpoolConfig,
     build_report,
     compare_reports,
@@ -214,6 +215,16 @@ SAMPLE_DATA = {
                          data_wait_fraction=0.05, nonfinite={}),
     "exception": dict(type="ValueError", message="boom", traceback="..."),
     "bundle": dict(trigger="p99_breach", path="/tmp/b", reason="r"),
+    "fleet_scale": dict(direction="out", replicas_before=1,
+                        replicas_after=2, reason="headroom_low",
+                        evidence={"headroom_streams": 0.7,
+                                  "scale_out_below": 1.0,
+                                  "per_replica": {"r0": 0.7}}),
+    "fleet_rebalance": dict(slots={"s0": "r0", "s1": "r1"},
+                            moved=["s1"], replicas=["r0", "r1"]),
+    "fleet_shed": dict(victim="s1", reason="budget_burn",
+                       burn_ratio=1.4,
+                       ranking=[["s1", 1.4], ["s0", 0.2]]),
 }
 
 
@@ -491,6 +502,55 @@ class TestReport:
         # and the identity diff is clean
         assert compare_reports(build_report(str(a)),
                                build_report(str(a)))["ok"] is True
+
+    def test_compare_thresholds_are_settable_and_stamped(self, tmp_path):
+        """CompareConfig lifts the tolerance constants into knobs: the
+        comparison output stamps the thresholds it ran with, and
+        loosening a knob waves the same regression through."""
+        a = _populated_archive(tmp_path, "base", device_cost=0.02)
+        b = _populated_archive(tmp_path, "cand", device_cost=0.1)
+        strict = compare_reports(build_report(str(a)),
+                                 build_report(str(b)))
+        assert strict["ok"] is False
+        assert strict["thresholds"] == CompareConfig().to_dict()
+        assert "thresholds:" in format_compare(strict)
+        # the injected 5x device cost shows up in device seconds AND the
+        # snapshotted e2e p99 — loosening both knobs waves it through
+        loose = compare_reports(build_report(str(a)),
+                                build_report(str(b)),
+                                CompareConfig(cost_ratio=10.0,
+                                              p99_ratio=10.0))
+        assert loose["ok"] is True
+        assert loose["thresholds"]["cost_ratio"] == 10.0
+
+    def test_gate_mode_exit_codes(self, tmp_path):
+        """--gate: regression → 1 (fail fast before chip time), identity
+        → 0, and a MISSING banked baseline passes with a note — a fresh
+        checkout must not be blocked by its own first run."""
+        a = _populated_archive(tmp_path, "base", device_cost=0.02)
+        b = _populated_archive(tmp_path, "cand", device_cost=0.1)
+        out = []
+        assert report_main([str(b)], compare=[str(a), str(b)],
+                           gate=True, out=out.append) == 1
+        assert any("GATE FAIL" in s for s in out)
+        out = []
+        assert report_main([str(a)], compare=[str(a), str(a)],
+                           gate=True, out=out.append) == 0
+        assert any("GATE PASS" in s for s in out)
+        out = []
+        missing = str(tmp_path / "never_banked")
+        assert report_main([str(a)], compare=[missing, str(a)],
+                           gate=True, out=out.append) == 0
+        assert any("no banked baseline" in s for s in out)
+        # the CLI wires the knobs through: loose cost_ratio turns the
+        # same gate green
+        from nerrf_tpu import cli
+
+        assert cli.main(["report", str(b), "--compare", str(a), str(b),
+                         "--gate"]) == 1
+        assert cli.main(["report", str(b), "--compare", str(a), str(b),
+                         "--gate", "--cost-ratio", "10",
+                         "--p99-ratio", "10"]) == 0
 
     def test_export_tune_distribution_and_cost_table(self, tmp_path):
         root = _populated_archive(tmp_path, "a")

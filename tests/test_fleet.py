@@ -1,0 +1,446 @@
+"""Fleet control plane: deterministic slot placement, hysteresis-banded
+autoscaling decisions, SLO-aware shedding, and the doctor's fleet section.
+
+The controller tests run against a FAKE pool (the five-method protocol
+documented on `FleetController`) with `poll_once(now=...)` pacing, so
+sustain counters and cooldowns are exercised without threads or clocks.
+The shedding tests drive the REAL admission path of the serve plane with
+a stub device program (tests/conftest.make_service_shell), a pinned
+headroom estimate, and observed SLO burn.
+"""
+
+import threading
+import time
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from nerrf_tpu.fleet import (
+    FleetConfig,
+    FleetController,
+    parse_gauge,
+    slot_map,
+    stable_slot,
+)
+from nerrf_tpu.flight.journal import EventJournal
+from nerrf_tpu.observability import MetricsRegistry
+from nerrf_tpu.serve import MicroBatcher, ServeConfig
+
+BUCKET = (256, 512, 64)
+
+
+# -- deterministic slot placement ---------------------------------------------
+
+def test_slot_map_is_deterministic_and_base_stream_keyed():
+    streams = [f"s{i}" for i in range(20)]
+    reps = ["r1", "r0", "r2"]
+    m1 = slot_map(streams, reps)
+    m2 = slot_map(list(reversed(streams)), sorted(reps))
+    assert m1 == m2  # order of inputs never matters
+    assert set(m1.values()) <= {"r0", "r1", "r2"}
+    # reconnect sessions follow the BASE stream — the same key the
+    # quarantine/SLO/quality ledgers use, so a moved stream's ledgers
+    # follow it by construction
+    assert stable_slot("s7#3", 3) == stable_slot("s7", 3)
+    assert slot_map(["s7#9"], reps)["s7#9"] == m1["s7"]
+    # restart/replay stability: the literal assignment is pinned — if
+    # this changes, rebalances fire on upgrade, which must be a choice
+    assert slot_map(["a", "b"], ["r0"]) == {"a": "r0", "b": "r0"}
+    assert slot_map([], reps) == {}
+    assert slot_map(["a"], []) == {}
+
+
+def test_parse_gauge_tolerates_malformed_scrapes():
+    text = ("# HELP nerrf_capacity_headroom_streams x\n"
+            "# TYPE nerrf_capacity_headroom_streams gauge\n"
+            "garbage-line-no-space\n"
+            "nerrf_capacity_headroom_streams_other 9\n"
+            'nerrf_fleet_headroom_streams{replica="r0"} 2.5\n'
+            "nerrf_capacity_headroom_streams 3.25\n")
+    assert parse_gauge(text, "nerrf_capacity_headroom_streams") == 3.25
+    assert parse_gauge(text, "nerrf_fleet_headroom_streams",
+                       labels={"replica": "r0"}) == 2.5
+    assert parse_gauge(text, "nerrf_fleet_headroom_streams",
+                       labels={"replica": "r1"}) is None
+    assert parse_gauge(text, "nope") is None
+    assert parse_gauge(None, "nerrf_capacity_headroom_streams") is None
+    assert parse_gauge("nerrf_capacity_headroom_streams NaN-ish x\n",
+                       "nerrf_capacity_headroom_streams") is None
+
+
+def test_fleet_config_rejects_inverted_hysteresis_band():
+    with pytest.raises(ValueError):
+        FleetConfig(scale_out_below=4.0, scale_in_above=4.0)
+
+
+# -- controller hysteresis over a fake pool -----------------------------------
+
+class FakePool:
+    """The five-method pool protocol with settable per-replica headroom."""
+
+    def __init__(self, headrooms, streams=()):
+        self.headrooms = dict(headrooms)  # name → float | None
+        self._streams = list(streams)
+        self.applied = []  # (mapping, moved) actuation log
+        self._seq = len(self.headrooms)
+
+    def replicas(self):
+        return {name: SimpleNamespace(
+                    scrape=lambda h=h: (
+                        None if h is None
+                        else f"nerrf_capacity_headroom_streams {h}\n"),
+                    ready=lambda: True)
+                for name, h in self.headrooms.items()}
+
+    def streams(self):
+        return list(self._streams)
+
+    def scale_out(self):
+        name = f"r{self._seq}"
+        self._seq += 1
+        self.headrooms[name] = 10.0  # fresh replica: all slack
+        return name
+
+    def scale_in(self, name):
+        self.headrooms.pop(name, None)
+
+    def apply_slots(self, mapping, moved):
+        self.applied.append((dict(mapping), list(moved)))
+
+
+def _controller(pool, **over):
+    reg = MetricsRegistry(namespace="t")
+    jrn = EventJournal(registry=reg)
+    cfg = FleetConfig(**{"scale_out_below": 1.5, "scale_in_above": 4.0,
+                         "scale_out_sustain": 2, "scale_in_sustain": 3,
+                         "cooldown_sec": 10.0, "max_replicas": 3,
+                         **over})
+    return FleetController(pool, cfg=cfg, registry=reg, journal=jrn), \
+        reg, jrn
+
+
+def test_scale_out_requires_sustain_and_fires_before_saturation():
+    pool = FakePool({"r0": 1.2})
+    ctl, reg, jrn = _controller(pool)
+    assert ctl.poll_once(now=0.0) is None          # 1st low tick: hold
+    d = ctl.poll_once(now=1.0)                     # 2nd: sustained → out
+    assert d is not None and d["direction"] == "out"
+    assert d["reason"] == "headroom_low"
+    # the trigger is the PREDICTED headroom crossing the band while still
+    # positive — i.e. strictly before the saturation point (headroom 0)
+    assert 0 < d["evidence"]["worst_headroom_streams"] < 1.5
+    assert d["replicas_after"] == 2
+    recs = [r for r in jrn.tail() if r.kind == "fleet_scale"]
+    assert len(recs) == 1
+    assert recs[0].data["evidence"]["per_replica"]["r0"] == 1.2
+    assert reg.value("fleet_replicas") == 2.0
+    assert reg.value("fleet_headroom_streams",
+                     labels={"replica": "r0"}) == 1.2
+
+
+def test_band_interior_resets_sustain_no_flapping():
+    pool = FakePool({"r0": 1.2})
+    ctl, _reg, jrn = _controller(pool)
+    # oscillate across the band edge: never two consecutive low polls
+    for i, h in enumerate([1.2, 2.0, 1.2, 3.9, 1.4, 2.0] * 4):
+        pool.headrooms["r0"] = h
+        assert ctl.poll_once(now=float(i)) is None
+    # and slack that never sustains does not scale in either
+    pool2 = FakePool({"r0": 5.0, "r1": 5.0})
+    ctl2, _reg2, _jrn2 = _controller(pool2)
+    for i, h in enumerate([5.0, 5.0, 2.0, 5.0, 5.0, 2.0] * 3):
+        pool2.headrooms["r1"] = h
+        assert ctl2.poll_once(now=float(i)) is None
+    assert not [r for r in jrn.tail() if r.kind == "fleet_scale"]
+
+
+def test_cooldown_blocks_back_to_back_decisions():
+    pool = FakePool({"r0": 1.0})
+    ctl, _reg, _jrn = _controller(pool, max_replicas=4)
+    assert ctl.poll_once(now=0.0) is None
+    assert ctl.poll_once(now=1.0)["direction"] == "out"
+    pool.headrooms["r1"] = 1.0  # both replicas still starved
+    for t in (2.0, 3.0, 4.0, 10.9):               # inside cooldown: hold
+        assert ctl.poll_once(now=t) is None
+    assert ctl.poll_once(now=12.0)["direction"] == "out"  # cooled down
+
+
+def test_scale_in_on_sustained_slack_respects_min_replicas():
+    pool = FakePool({"r0": 9.0, "r1": 9.0})
+    ctl, _reg, jrn = _controller(pool, scale_in_sustain=3)
+    assert ctl.poll_once(now=0.0) is None
+    assert ctl.poll_once(now=1.0) is None
+    d = ctl.poll_once(now=2.0)
+    assert d is not None and d["direction"] == "in"
+    assert d["reason"] == "sustained_slack"
+    assert d["replica"] == "r1"  # deterministic victim: last in sort order
+    assert pool.headrooms.keys() == {"r0"}
+    # at min_replicas the same sustained slack holds forever
+    for t in (20.0, 21.0, 22.0, 23.0):
+        assert ctl.poll_once(now=t) is None
+    assert len([r for r in jrn.tail() if r.kind == "fleet_scale"]) == 1
+
+
+def test_worst_replica_drives_the_decision_and_dead_scrapes_are_skipped():
+    pool = FakePool({"r0": 9.0, "r1": 1.0, "r2": None})
+    ctl, _reg, jrn = _controller(pool, max_replicas=4)
+    ctl.poll_once(now=0.0)
+    d = ctl.poll_once(now=1.0)
+    assert d is not None and d["direction"] == "out"
+    assert d["evidence"]["worst_headroom_streams"] == 1.0
+    assert d["evidence"]["per_replica"]["r2"] is None
+    # all scrapes dead → no signal, no decision, no crash
+    pool2 = FakePool({"r0": None})
+    ctl2, _reg2, _jrn2 = _controller(pool2)
+    for t in (0.0, 1.0, 2.0):
+        assert ctl2.poll_once(now=t) is None
+
+
+def test_idle_replica_stale_gauge_reads_as_slack_and_is_retired_first():
+    # both streams hash onto r0 under a 2-replica map, leaving r1 empty;
+    # r1's gauge is frozen at a busy-era 1.0 (nothing updates an idle
+    # estimator) — trusting it would both trigger a bogus scale-out and
+    # wedge scale-in forever
+    streams = [s for s in ("a", "b", "c", "d", "e", "f")
+               if stable_slot(s, 2) == 0][:2]
+    assert len(streams) == 2
+    pool = FakePool({"r0": 9.0, "r1": 1.0}, streams=streams)
+    ctl, _reg, jrn = _controller(pool, scale_in_sustain=2)
+    assert ctl.poll_once(now=0.0) is None  # placement poll: slots learned
+    assert ctl.poll_once(now=1.0) is None  # slack tick 1 (r1 ignored)
+    d = ctl.poll_once(now=2.0)
+    assert d is not None and d["direction"] == "in"
+    assert d["replica"] == "r1"  # the empty replica, not sort-order last
+    assert d["evidence"]["idle_replicas"] == ["r1"]
+    assert d["evidence"]["worst_headroom_streams"] == 9.0
+    recs = [r for r in jrn.tail() if r.kind == "fleet_scale"]
+    assert recs[-1].data["evidence"]["idle_replicas"] == ["r1"]
+
+
+def test_rebalance_applies_slot_map_and_journals_only_real_moves():
+    pool = FakePool({"r0": 1.2}, streams=["a", "b", "c", "d"])
+    ctl, reg, jrn = _controller(pool)
+    ctl.poll_once(now=0.0)
+    # first reconciliation: everything placed on r0, nothing MOVED
+    assert pool.applied[-1][0] == slot_map(["a", "b", "c", "d"], ["r0"])
+    assert not [r for r in jrn.tail() if r.kind == "fleet_rebalance"]
+    assert reg.value("fleet_rebalances_total") == 0.0
+    # scale out → the slot map spreads over two replicas → a real move
+    d = ctl.poll_once(now=1.0)
+    assert d is not None and d["direction"] == "out"
+    mapping, moved = pool.applied[-1]
+    assert mapping == slot_map(["a", "b", "c", "d"], ["r0", "r1"])
+    assert moved == sorted(s for s, r in mapping.items() if r != "r0")
+    recs = [r for r in jrn.tail() if r.kind == "fleet_rebalance"]
+    assert len(recs) == 1
+    assert recs[0].data["moved"] == moved
+    assert recs[0].data["slots"] == mapping
+    assert reg.value("fleet_rebalances_total") == 1.0
+    # steady state: identical map → no re-apply, no new record
+    applied_before = len(pool.applied)
+    ctl.poll_once(now=2.0)
+    assert len(pool.applied) == applied_before
+
+
+def test_controller_thread_lifecycle_is_bounded():
+    pool = FakePool({"r0": 2.0})
+    ctl, _reg, _jrn = _controller(pool, poll_sec=0.05)
+    ctl.start()
+    try:
+        deadline = time.monotonic() + 5.0
+        while not ctl.decisions and time.monotonic() < deadline:
+            pool.headrooms["r0"] = 1.0
+            time.sleep(0.02)
+    finally:
+        ctl.stop()
+    assert ctl.decisions  # the loop polled and decided on its own
+    assert not any(t.name == "nerrf-fleet-controller"
+                   for t in threading.enumerate())
+
+
+# -- SLO-aware shedding in the serve admission path ---------------------------
+
+def _shed_service(slots=2, margin=1.0, headroom=0.2):
+    """Real admission + stub batcher, scoring wedged so queues only grow;
+    headroom pinned under the shed margin (fleet-wide pressure)."""
+    from conftest import make_service_shell
+
+    gate = threading.Event()
+
+    def wedged(batch):
+        gate.wait(timeout=30.0)
+        return np.zeros(batch["node_mask"].shape)
+
+    cfg = ServeConfig(buckets=(BUCKET,), batch_size=8,
+                      batch_close_sec=30.0, stream_queue_slots=slots,
+                      window_sec=10.0, stride_sec=5.0,
+                      shed_headroom_margin=margin)
+    svc, reg = make_service_shell(cfg)
+    svc._batcher = MicroBatcher(score_fn=wedged, cfg=cfg, registry=reg,
+                                on_scored=svc._on_scored,
+                                on_failed=svc._on_failed,
+                                journal=svc._journal)
+    for b in cfg.buckets:
+        svc._batcher.mark_warm(b)
+    svc._batcher.start()
+    svc._admission_open = True
+    svc._devtime = SimpleNamespace(
+        last_estimate=SimpleNamespace(headroom_streams=headroom),
+        observe_admit=lambda *a, **k: None,
+        observe_batch=lambda *a, **k: None)
+    return svc, reg, gate
+
+
+def _burn(svc, stream, ratio):
+    """Observe one window whose DEVICE stage burns `ratio` of the SLO
+    budget — the stage the shed ranking scores (queue/pack burn is
+    suffered behind the shared FIFO, not caused, so it must not rank;
+    see _select_shed_victim)."""
+    sec = svc.cfg.window_deadline_sec * ratio
+    svc._slo.observe(stream, f"t-{stream}", 0, {"device": sec}, sec)
+
+
+def _fill(svc, stream, seed):
+    """Feed a stream until its bounded queue is full (scoring wedged)."""
+    from test_serve import _blocks, _sim
+
+    if stream not in svc._streams:
+        svc.join(stream)
+    tr = _sim(seed=seed, duration=120.0, files=4, rate=6.0)
+    for b in _blocks(tr, size=400):
+        svc.feed(stream, b, tr.strings)
+    return tr
+
+
+def test_shed_ranks_victims_by_budget_burn_not_arrival_order():
+    svc, reg, gate = _shed_service(slots=2)
+    try:
+        _fill(svc, "burner", seed=9)
+        burner_live_before = dict(svc._streams["burner"].live)
+        # the fill itself overflows burner's own queue (classic
+        # drop-oldest: no SLO burn observed yet, nobody else pays)
+        burner_drops_own = svc._streams["burner"].dropped
+        assert len(burner_live_before) == 2
+        _burn(svc, "burner", 5.0)   # burner torches its budget
+        _burn(svc, "healthy", 0.1)  # healthy well inside it
+        _fill(svc, "healthy", seed=10)  # healthy overflows under pressure
+        sheds = [r for r in svc._journal.tail() if r.kind == "fleet_shed"]
+        assert sheds, "overflow under pressure must shed the burner"
+        for r in sheds:
+            assert r.stream == "burner"
+            assert r.data["reason"] == "budget_burn"
+            assert r.data["admitting"].startswith("healthy")
+            # the victim is the TOP of the recorded burn ranking
+            assert r.data["ranking"][0][0] == "burner"
+            assert r.data["burn_ratio"] == pytest.approx(5.0, rel=0.01)
+        # burner paid from its OLDEST window (drop-oldest inside the
+        # victim); healthy kept everything, stretched past its own bound
+        h_burn = svc._streams["burner"]
+        h_heal = svc._streams["healthy"]
+        assert h_burn.dropped - burner_drops_own == len(sheds)
+        assert min(burner_live_before) not in h_burn.live
+        assert h_heal.admitted > 2
+        assert len(h_heal.live) > 2          # stretched beyond slots...
+        assert len(h_heal.live) <= 4         # ...but hard-capped at 2x
+        assert reg.value("fleet_shed_total",
+                         labels={"stream": "burner",
+                                 "reason": "budget_burn"}) == len(sheds)
+        assert reg.value("serve_admission_dropped_total",
+                         labels={"reason": "shed"}) == len(sheds)
+    finally:
+        gate.set()
+        svc.stop(drain=False)
+
+
+def test_no_pressure_or_disabled_falls_back_to_drop_oldest():
+    # slack headroom: classic per-stream drop-oldest, nobody else pays
+    svc, reg, gate = _shed_service(slots=2, headroom=50.0)
+    try:
+        _fill(svc, "burner", seed=9)
+        drops_before = svc._streams["burner"].dropped
+        _burn(svc, "burner", 5.0)
+        _fill(svc, "healthy", seed=10)
+        assert not [r for r in svc._journal.tail()
+                    if r.kind == "fleet_shed"]
+        h = svc._streams["healthy"]
+        assert len(h.live) == 2              # own bound, own victims
+        assert h.dropped == h.admitted - 2
+        assert svc._streams["burner"].dropped == drops_before
+    finally:
+        gate.set()
+        svc.stop(drain=False)
+
+
+def test_shed_never_picks_quarantined_or_lesser_burners():
+    svc, _reg, gate = _shed_service(slots=2)
+    try:
+        _fill(svc, "burner", seed=9)
+        drops_before = svc._streams["burner"].dropped
+        _burn(svc, "burner", 5.0)
+        svc._quarantined["burner"] = time.monotonic()  # exempt: already shed
+        _burn(svc, "healthy", 0.1)
+        _fill(svc, "healthy", seed=10)
+        assert not [r for r in svc._journal.tail()
+                    if r.kind == "fleet_shed"]
+        assert svc._streams["burner"].dropped == drops_before
+        # and a victim must burn STRICTLY more than the admitting stream:
+        # the top burner overflowing its own queue gets the classic path
+        del svc._quarantined["burner"]
+        assert svc._select_shed_victim("burner") is None
+        # ...while anyone burning less still finds the burner
+        picked = svc._select_shed_victim("healthy")
+        assert picked is not None and picked[0].id == "burner"
+    finally:
+        gate.set()
+        svc.stop(drain=False)
+
+
+def test_shed_ranking_scores_caused_device_burn_not_suffered_queue_wait():
+    # the part-C physics: on a saturated shared FIFO a healthy stream's
+    # TOTAL burn converges to the deadline (it waits behind the burner's
+    # batches), so ranking on the total would shed the victim of the
+    # pressure, not its cause — only device occupancy may rank
+    svc, _reg, gate = _shed_service(slots=2)
+    try:
+        _fill(svc, "burner", seed=9)
+        _fill(svc, "waiter", seed=10)
+        # waiter torches its whole budget QUEUED behind the shared FIFO
+        # (suffered); burner's burn is modest but on the DEVICE (caused)
+        svc._slo.observe("waiter", "t-w", 0, {"queue": 9.9}, 9.9)
+        _burn(svc, "burner", 0.5)
+        picked = svc._select_shed_victim("waiter")
+        assert picked is not None and picked[0].id == "burner"
+        assert dict(picked[2]).get("waiter") is None  # zero device burn
+    finally:
+        gate.set()
+        svc.stop(drain=False)
+
+
+# -- doctor: fleet section ----------------------------------------------------
+
+def test_doctor_fleet_section_renders_decisions_and_degrades():
+    from nerrf_tpu.flight.doctor import fleet_section
+
+    reg = MetricsRegistry(namespace="t")
+    jrn = EventJournal(registry=reg)
+    jrn.record("fleet_scale", direction="out", replica="r1",
+               replicas_before=1, replicas_after=2, reason="headroom_low",
+               evidence={"worst_headroom_streams": 1.2,
+                         "per_replica": {"r0": 1.2},
+                         "scale_out_below": 1.5, "scale_in_above": 4.0})
+    jrn.record("fleet_rebalance", slots={"a": "r1"}, moved=["a"],
+               replicas=["r0", "r1"])
+    jrn.record("fleet_shed", stream="burner", victim="burner",
+               reason="budget_burn", burn_ratio=5.0,
+               ranking=[["burner", 5.0]])
+    bundle = {"manifest": {}, "records": jrn.tail()}
+    text = "\n".join(fleet_section(bundle))
+    assert "scale out" in text and "1→2 replicas" in text
+    assert "worst_headroom=1.2" in text
+    assert "rebalance: 1 stream(s) moved" in text
+    assert "shed burner" in text and "burn=5" in text
+    assert "per-replica headroom at last scale decision: r0=1.2" in text
+    # single-replica bundle: one polite line, not an empty table
+    empty = fleet_section({"manifest": {}, "records": []})
+    assert len(empty) == 1 and "no fleet records" in empty[0]
