@@ -793,6 +793,38 @@ def main() -> None:
         return _smoke_or_artifact("fleet", "run_fleet_bench.py",
                                   "fleet_bench_cpu.json", surface)
 
+    def _respond():
+        # incident-response tier: adversarial corpus through the live
+        # router, B=1 parity vs the offline planner, batched-vs-
+        # sequential throughput, verify-before-surface (docs/response.md)
+        def surface(r):
+            corpus = r.get("corpus") or {}
+            thr = r.get("throughput") or {}
+            return {
+                "batched_vs_sequential_speedup": r.get("value"),
+                "wall_speedup": thr.get("wall_speedup"),
+                "device_call_amortization":
+                    thr.get("device_call_amortization"),
+                "batched_incidents_per_sec": (
+                    thr.get("batched") or {}).get("incidents_per_sec"),
+                "families_verified": {
+                    name: f.get("verified_rate")
+                    for name, f in (corpus.get("families") or {}).items()
+                },
+                "quarantine_reasons_journaled": (
+                    corpus.get("quarantine") or {}).get("journaled_reasons"),
+                "parity_bit_identical": (
+                    r.get("parity") or {}).get("bit_identical"),
+                "recompiles_after_warmup":
+                    r.get("recompiles_after_warmup"),
+                "backend": r.get("backend"),
+                "smoke": r.get("smoke"),
+                "provenance": r.get("provenance"),
+            }
+
+        return _smoke_or_artifact("respond", "run_respond_bench.py",
+                                  "respond_bench_cpu.json", surface)
+
     # per-artifact isolation: one truncated/corrupt JSON on disk must not
     # silently drop the valid artifacts after it
     for key, loader in (("corpus100h", _j100), ("adversarial", _adv),
@@ -800,7 +832,7 @@ def main() -> None:
                         ("serve", _serve), ("model_swap", _swap),
                         ("chaos", _chaos), ("quality", _quality),
                         ("train_health", _train_health), ("tune", _tune),
-                        ("fleet", _fleet)):
+                        ("fleet", _fleet), ("respond", _respond)):
         try:
             entry = loader()
             if entry is not None:

@@ -118,6 +118,16 @@ REQUIRED = (
     "fleet_headroom_streams",
     "fleet_rebalances_total",
     "fleet_shed_total",
+    # the respond tier (docs/response.md; run_respond_bench's gates and
+    # the incident-response runbook key off these exact names —
+    # respond_recompiles_total staying 0 after warmup IS the
+    # zero-recompile contract, and the plans_total outcome split is how
+    # a quarantine storm shows up on a dashboard)
+    "respond_incidents_total",
+    "respond_plans_total",
+    "respond_plan_seconds",
+    "respond_queue_depth",
+    "respond_recompiles_total",
 )
 
 _CALL = re.compile(

@@ -30,6 +30,7 @@ TRAIN_LOOP = "nerrf_tpu/train/loop.py"
 SERVE_SERVICE = "nerrf_tpu/serve/service.py"
 RING = "nerrf_tpu/parallel/ring.py"
 PARALLEL_TRAIN = "nerrf_tpu/parallel/train.py"
+RESPOND_PLANNER = "nerrf_tpu/respond/planner.py"
 
 
 def _micro_ds_cfg():
@@ -179,11 +180,55 @@ def cache_key_entries() -> List[CacheKeyEntry]:
         base_model,
         gnn=dataclasses.replace(base_model.gnn, aggregation="dense_adj"))
 
+    def respond_variant(mcts_cfg, max_steps):
+        """(build, extra) for the respond tier's batched search at one
+        point of its config axis — build resolves the EXACT vmapped
+        closure the router warms (`respond._batched_programs`), so the
+        audit traces the production program, not a stand-in."""
+        from nerrf_tpu.planner.device_mcts import DeviceMCTS
+        from nerrf_tpu.respond.planner import (_batched_programs,
+                                               _stack_ctx,
+                                               respond_program_key)
+
+        B = 2
+
+        def build():
+            import jax.numpy as jnp
+
+            dm = DeviceMCTS.warmup_for(4, 2, mcts_cfg,
+                                       max_steps=max_steps)
+            dims = dm._dims
+            init_b, search_b = _batched_programs(
+                dims["F"], dims["P"], mcts_cfg.num_simulations + 1,
+                float(dm.domain.max_steps), float(mcts_cfg.c_puct),
+                None, B)
+            roots = jnp.stack(
+                [jnp.asarray(dm._pad_state(dm.domain.initial_state()))] * B)
+            tree = init_b(roots)
+            ctx = _stack_ctx([dm._ctx] * B)
+            return search_b, (tree, jnp.asarray(1, jnp.int32), ctx)
+
+        # bucket floors make micro dims land in the 256f/16p bucket
+        return build, respond_program_key(256, 16, B, mcts_cfg,
+                                          float(max_steps))
+
+    def _micro_mcts(**over):
+        from nerrf_tpu.planner.mcts import MCTSConfig
+
+        return MCTSConfig(num_simulations=over.pop("num_simulations", 4),
+                          **over)
+
     t_base, t_base_extra = train_variant(cfg)
     t_pw, t_pw_extra = train_variant(cfg_pw)
     t_tel, t_tel_extra = train_variant(cfg_tel)
     s_base, s_base_extra = serve_variant(base_model)
     s_agg, s_agg_extra = serve_variant(agg_model)
+    # perturbations that change the search program while keeping the tree/
+    # ctx avals identical: the PUCT constant and the step horizon are both
+    # folded into the lowered HLO as literals
+    r_base, r_base_extra = respond_variant(_micro_mcts(), 64)
+    r_puct, r_puct_extra = respond_variant(_micro_mcts(c_puct=2.5), 64)
+    r_horizon, r_horizon_extra = respond_variant(_micro_mcts(), 32)
     return [
         CacheKeyEntry(
             name="train_step_flat", path=TRAIN_LOOP,
@@ -194,4 +239,9 @@ def cache_key_entries() -> List[CacheKeyEntry]:
             name="serve_eval", path=SERVE_SERVICE,
             variants=[("base", s_base, s_base_extra),
                       ("aggregation", s_agg, s_agg_extra)]),
+        CacheKeyEntry(
+            name="respond_search", path=RESPOND_PLANNER,
+            variants=[("base", r_base, r_base_extra),
+                      ("c_puct", r_puct, r_puct_extra),
+                      ("max_steps", r_horizon, r_horizon_extra)]),
     ]

@@ -1128,6 +1128,44 @@ def cmd_serve_detect(args) -> int:
                                 log=_log)
         service.attach_archive(archive)
         _log(f"telemetry archive spooling to {args.archive_dir}")
+    responder = None
+    respond_ctx = None
+    if args.respond:
+        # online incident-response tier (docs/response.md): every alert at
+        # or above the calibrated-severity gate becomes an incident, a
+        # vmapped DeviceMCTS plans micro-batches of them, and each plan
+        # replays through the rollback sandbox gate before surfacing.
+        # Warmed through the same compile cache as the serve ladder.
+        from nerrf_tpu.respond import RespondConfig, ResponseRouter
+
+        responder = ResponseRouter(
+            RespondConfig(severity_min=args.respond_severity),
+            cache=compile_cache)
+        if args.respond_store and args.respond_root:
+            # a snapshot handle for the served streams: with it, plans
+            # are verifiable; without it every plan is quarantined
+            # (fail closed), which is still the correct default
+            from nerrf_tpu.respond import VerifyContext
+            from nerrf_tpu.rollback.store import SnapshotStore
+
+            snap_store = SnapshotStore(args.respond_store)
+            snap_id = args.respond_snapshot or \
+                (snap_store.list_manifests() or [None])[-1]
+            if snap_id is None:
+                _log(f"respond: no manifests in {args.respond_store} — "
+                     f"plans will be quarantined unverified")
+            else:
+                respond_ctx = VerifyContext(
+                    store=snap_store,
+                    manifest=snap_store.load_manifest(snap_id),
+                    victim_root=Path(args.respond_root))
+                _log(f"respond: verifying against snapshot {snap_id} "
+                     f"over {args.respond_root}")
+        service.attach_respond(responder)
+        responder.start()
+        _log(f"respond tier armed: severity>={args.respond_severity:g}, "
+             f"{len(responder.cfg.batch_slots)} batch programs warmed in "
+             f"{responder.warmup_seconds:.1f}s")
     recorder = None
     uninstall_crash = None
     if args.flight_dir:
@@ -1192,6 +1230,9 @@ def cmd_serve_detect(args) -> int:
         if not targets:
             _log("nothing to serve: pass --target and/or --trace")
             return 2
+        if responder is not None and respond_ctx is not None:
+            for name, _addr in targets:
+                responder.bind_context(name, respond_ctx)
         runs = [service.connect(name, addr, timeout=args.stream_timeout,
                                 follow=args.follow)
                 for name, addr in targets]
@@ -1257,6 +1298,9 @@ def cmd_serve_detect(args) -> int:
             DEFAULT_REGISTRY.value("serve_recompiles_total",
                                    labels={"bucket": _btag(b)}) or 0
             for b in cfg.buckets)
+        if responder is not None:
+            responder.drain(timeout=30.0)
+            summary["respond"] = responder.stats()
         print(json.dumps(summary, indent=2))
         return 0
     except BaseException as e:
@@ -1276,6 +1320,8 @@ def cmd_serve_detect(args) -> int:
     finally:
         if manager is not None:
             manager.close()
+        if responder is not None:
+            responder.stop()
         service.stop()
         for rs in replays:
             rs.stop()
@@ -1290,6 +1336,62 @@ def cmd_serve_detect(args) -> int:
             archive.close()
         if uninstall_crash is not None:
             uninstall_crash()
+
+
+def cmd_respond(args) -> int:
+    """The incident-response corpus end to end, no serve pod needed: stage
+    each adversarial family on disk (victim tree snapshotted FIRST), run
+    detection on the attack trace, plan every incident through the
+    batched vmapped planner, replay every plan through the rollback
+    sandbox gate.  One JSON report; exit 1 if any family failed to
+    produce a verified plan (docs/response.md)."""
+    import tempfile
+
+    from nerrf_tpu.pipeline import heuristic_detect
+    from nerrf_tpu.respond import (
+        FAMILIES,
+        RespondConfig,
+        ResponseRouter,
+        stage_incident,
+    )
+
+    fams = tuple(args.family or FAMILIES)
+    unknown = [f for f in fams if f not in FAMILIES]
+    if unknown:
+        _log(f"unknown family {unknown} (know {list(FAMILIES)})")
+        return 2
+    cfg = RespondConfig(num_simulations=args.sims,
+                        verify=not args.no_verify)
+    work = Path(args.work_dir) if args.work_dir else Path(
+        tempfile.mkdtemp(prefix="nerrf_respond_"))
+    work.mkdir(parents=True, exist_ok=True)
+    _log(f"staging {len(fams)} families under {work}")
+    router = ResponseRouter(cfg).start()
+    try:
+        for fam in fams:
+            staged = stage_incident(work, fam, seed=args.seed,
+                                    files=args.files)
+            det = heuristic_detect(staged.trace)
+            _log(f"{fam}: {len(det.flagged_files())} files flagged, "
+                 f"{len(det.proc_scores)} procs")
+            router.submit_detection(fam, det,
+                                    context=staged.verify_context())
+        drained = router.drain(
+            timeout=cfg.timeout_seconds * len(fams) + 120.0)
+        report = {
+            "families": {vp.incident.stream: vp.to_dict()
+                         for vp in router.results()},
+            "stats": router.stats(),
+            "drained": drained,
+        }
+    finally:
+        router.stop()
+    print(json.dumps(report, indent=2))
+    complete = drained and len(report["families"]) == len(fams)
+    verified = args.no_verify or all(
+        v["verified"] for v in report["families"].values())
+    clean = report["stats"]["recompiles"] == 0
+    return 0 if (complete and verified and clean) else 1
 
 
 def cmd_ingest(args) -> int:
@@ -1838,7 +1940,51 @@ def main(argv=None) -> int:
                         "live jax.profiler trace into every p99-breach "
                         "bundle (jax_trace/, summarized by `nerrf "
                         "doctor`); 0 disables")
+    p.add_argument("--respond", action="store_true",
+                   help="arm the online incident-response tier: alerts at "
+                        "or above --respond-severity become incidents, a "
+                        "batched vmapped planner emits undo plans, and "
+                        "every plan is sandbox-verified before surfacing "
+                        "(docs/response.md)")
+    p.add_argument("--respond-severity", type=float, default=0.5,
+                   help="calibrated-severity admission floor for the "
+                        "respond tier (0..1; the demux-boundary number "
+                        "alert consumers also see)")
+    p.add_argument("--respond-store", default=None, metavar="DIR",
+                   help="snapshot store for plan verification; without it "
+                        "every plan is quarantined unverified (fail "
+                        "closed)")
+    p.add_argument("--respond-snapshot", default=None, metavar="ID",
+                   help="manifest id in --respond-store to verify against "
+                        "(default: the latest)")
+    p.add_argument("--respond-root", default=None, metavar="DIR",
+                   help="live tree the verified plans would roll back "
+                        "(rehearsals run on a clone, never on this tree)")
     p.set_defaults(fn=cmd_serve_detect)
+
+    p = sub.add_parser("respond",
+                       help="incident-response corpus end to end: stage "
+                            "adversarial families on disk, detect, plan "
+                            "in vmapped batches, sandbox-verify every "
+                            "plan (docs/response.md)")
+    p.add_argument("--family", action="append", default=None,
+                   help="attack family to stage (repeatable; default all: "
+                        "mass-rename, exfil-staging, cron-persistence, "
+                        "log-tamper)")
+    p.add_argument("--seed", type=int, default=0,
+                   help="deterministic corpus seed (same seed = same "
+                        "victims, same damage, same trace)")
+    p.add_argument("--files", type=int, default=6,
+                   help="victim files per family")
+    p.add_argument("--sims", type=int, default=96,
+                   help="MCTS simulation budget per batched search")
+    p.add_argument("--no-verify", action="store_true",
+                   help="skip sandbox verification (throughput probing "
+                        "only — plans surface UNVERIFIED)")
+    p.add_argument("--work-dir", default=None, metavar="DIR",
+                   help="where victim trees + snapshots are staged "
+                        "(default: a fresh temp dir)")
+    p.set_defaults(fn=cmd_respond)
 
     p = sub.add_parser("chaos", help="chaos plane: fault-point catalog, "
                                      "plan validation, example schedule "

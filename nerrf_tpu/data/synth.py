@@ -99,6 +99,19 @@ class SimConfig:
     #                           .tmp → file): the write→rename motif fires on
     #                           every file, so the heuristic mass-flags a
     #                           benign maintenance job (FP-undo probe).
+    #
+    # Incident-response families (the respond tier's scenario corpus,
+    # nerrf_tpu/respond/scenarios.py — these exercise the detect→plan→
+    # verify loop on damage that is NOT encryption):
+    #   "cron-persistence"    — the attacker trojanizes the host agent's
+    #                           plugin binaries via the atomic-replace idiom
+    #                           (write payload tmp, rename onto the plugin)
+    #                           and drops a hidden cron entry for boot
+    #                           persistence; no victim data files touched.
+    #   "log-tamper"          — anti-forensics: every application log is
+    #                           scrubbed by rewriting it through a tmp copy
+    #                           (same size, incriminating entries gone) and
+    #                           renaming the copy over the original.
     scenario: str = "standard"
 
 
@@ -109,6 +122,20 @@ BENIGN_SCENARIOS = frozenset({"benign-mass-rename", "benign-atomic-rewrite"})
 STEALTH_SCENARIOS = frozenset(
     {"inplace-stealth", "partial-encrypt", "interleaved-backup",
      "exfil-encrypt"})
+
+# Incident-response families: damage that is persistence/anti-forensics
+# rather than encryption.  Kept OUT of ATTACK_VARIANTS on purpose — the
+# hard-corpus slot arithmetic in make_corpus (0.49/len) is frozen so the
+# historical corpus mix stays bit-identical; the respond tier's scenario
+# schedules (nerrf_tpu/respond/scenarios.py) draw these explicitly.
+PERSISTENCE_VARIANTS = ("cron-persistence", "log-tamper")
+
+# Where the persistence families do their damage (shared with the on-disk
+# incident simulators in respond/scenarios.py so trace paths and disk paths
+# agree).
+PLUGIN_DIR = "/usr/lib/sysagent"
+CRON_DROP = "/etc/cron.d/.sysupdate"
+TAMPER_LOG_DIR = "/var/log/app"
 
 
 _BENIGN_SERVICES = (
@@ -300,6 +327,10 @@ def _emit_attack(em: _Emitter, cfg: SimConfig, rng: np.random.Generator, t0: int
         return _emit_attack_multiprocess(em, cfg, rng, t0)
     if cfg.scenario in STEALTH_SCENARIOS:
         return _emit_attack_stealth(em, cfg, rng, t0)
+    if cfg.scenario == "cron-persistence":
+        return _emit_attack_cron_persistence(em, cfg, rng, t0)
+    if cfg.scenario == "log-tamper":
+        return _emit_attack_log_tamper(em, cfg, rng, t0)
     # benign-comm: reuse the benign python3 app worker's identity (pid 202,
     # the pids[] entry _emit_benign uses), so comm/pid features are useless
     pid = 202 if cfg.scenario == "benign-comm" else 4567
@@ -542,6 +573,103 @@ def _emit_attack_stealth(em: _Emitter, cfg: SimConfig,
     return start, end
 
 
+def _emit_attack_cron_persistence(em: _Emitter, cfg: SimConfig,
+                                  rng: np.random.Generator,
+                                  t0: int) -> tuple[int, int]:
+    """Persistence family: the attacker trojanizes the host agent's plugin
+    binaries via the atomic-replace idiom (write the payload to a dotfile
+    tmp, rename it onto the plugin — the write→rename motif, but aimed at
+    *code*, not documents) and drops a hidden cron entry for boot
+    persistence.  No victim data file is touched and nothing is encrypted:
+    the undo plan the respond tier must produce is "restore the trojanized
+    binaries from snapshot", and the cron drop is attack residue the
+    rollback gate's leaves-behind policy has to account for."""
+    pid, comm = 4913, "python3"
+    t = t0 + int(cfg.attack_start_sec * _NS)
+    start = t
+
+    def step(lo_ms=2, hi_ms=40):
+        nonlocal t
+        t += int(rng.uniform(lo_ms, hi_ms) * 1e6)
+        return t
+
+    # Light recon: privilege + persistence-surface survey.
+    for p in ("/proc/self/status", "/etc/passwd", "/proc/mounts"):
+        em.emit(step(), Syscall.OPENAT, p, pid=pid, comm=comm, attack=True,
+                flags=int(OpenFlags.O_RDONLY))
+        em.emit(step(), Syscall.READ, p, pid=pid, comm=comm, attack=True,
+                nbytes=int(rng.integers(512, 2048)))
+
+    n = max(4, min(cfg.num_target_files, 12))
+    names = [f"{PLUGIN_DIR}/plugin_{i:02d}.bin" for i in range(n)]
+    em.emit(step(), Syscall.OPENAT, PLUGIN_DIR, pid=pid, comm=comm,
+            attack=True, flags=int(OpenFlags.O_RDONLY))
+    for nm in names:
+        em.emit(step(1, 4), Syscall.STAT, nm, pid=pid, comm=comm, attack=True)
+
+    for i, nm in enumerate(names):
+        tmp = f"{PLUGIN_DIR}/.tmp_{i:02d}.bin"
+        size = int(rng.integers(cfg.min_file_bytes, cfg.max_file_bytes))
+        em.emit(step(), Syscall.OPENAT, nm, pid=pid, comm=comm, attack=True,
+                flags=int(OpenFlags.O_RDONLY))
+        for _ in range(max(1, size // cfg.chunk_bytes)):
+            em.emit(step(1, 3), Syscall.READ, nm, pid=pid, comm=comm,
+                    attack=True, nbytes=cfg.chunk_bytes)
+            em.emit(step(1, 3), Syscall.WRITE, tmp, pid=pid, comm=comm,
+                    attack=True, nbytes=cfg.chunk_bytes, victim=True)
+        # the tmp's inode (already marked victim) is carried onto the plugin
+        # name by the rename — the canonical final path is the binary itself
+        em.emit(step(), Syscall.RENAME, tmp, pid=pid, comm=comm, attack=True,
+                new_path=nm, victim=True)
+
+    # Boot persistence: one small hidden cron entry (attack residue — a
+    # path the snapshot manifest has never seen).
+    em.emit(step(), Syscall.OPENAT, CRON_DROP, pid=pid, comm=comm,
+            attack=True, flags=int(OpenFlags.O_WRONLY))
+    em.emit(step(), Syscall.WRITE, CRON_DROP, pid=pid, comm=comm,
+            attack=True, nbytes=142)
+    return start, t
+
+
+def _emit_attack_log_tamper(em: _Emitter, cfg: SimConfig,
+                            rng: np.random.Generator,
+                            t0: int) -> tuple[int, int]:
+    """Anti-forensics family: audit logs are scrubbed by rewriting each one
+    through a same-size tmp copy (incriminating entries replaced, byte count
+    preserved so log-size monitors see nothing) and renaming the copy over
+    the original.  No recon burst — the actor is already inside — and the
+    touched directory is one benign services write to constantly, so the
+    only signal is the write→rename motif on files nothing benign ever
+    renames onto."""
+    pid, comm = 5102, "python3"
+    t = t0 + int(cfg.attack_start_sec * _NS)
+    start = t
+
+    def step(lo_ms=2, hi_ms=40):
+        nonlocal t
+        t += int(rng.uniform(lo_ms, hi_ms) * 1e6)
+        return t
+
+    n = max(3, min(cfg.num_target_files, 10))
+    logs = [f"{TAMPER_LOG_DIR}/audit_{i:02d}.log" for i in range(n)]
+    for i, lg in enumerate(logs):
+        em.emit(step(1, 4), Syscall.STAT, lg, pid=pid, comm=comm, attack=True)
+        tmp = f"{TAMPER_LOG_DIR}/.audit_{i:02d}.swp"
+        size = int(rng.integers(cfg.min_file_bytes, cfg.max_file_bytes))
+        em.emit(step(), Syscall.OPENAT, lg, pid=pid, comm=comm, attack=True,
+                flags=int(OpenFlags.O_RDONLY))
+        for _ in range(max(1, size // cfg.chunk_bytes)):
+            em.emit(step(1, 3), Syscall.READ, lg, pid=pid, comm=comm,
+                    attack=True, nbytes=cfg.chunk_bytes)
+            # same-size scrub copy: bytes out == bytes in
+            em.emit(step(1, 3), Syscall.WRITE, tmp, pid=pid, comm=comm,
+                    attack=True, nbytes=cfg.chunk_bytes, victim=True)
+        em.emit(step(), Syscall.RENAME, tmp, pid=pid, comm=comm, attack=True,
+                new_path=lg, victim=True)
+        t += int(rng.uniform(5, 20) * 1e6)
+    return start, t
+
+
 def _emit_benign_atomic_rewrite(em: _Emitter, cfg: SimConfig,
                                 rng: np.random.Generator, t0: int) -> None:
     """Hard negative: an indexer refreshes every target file via the
@@ -589,11 +717,17 @@ def simulate_trace(cfg: SimConfig, name: str = "") -> Trace:
         _emit_benign_atomic_rewrite(em, cfg, rng, t0)
     elif cfg.attack:
         start, end = _emit_attack(em, cfg, rng, t0)
+        family, tgt = {
+            # the persistence families damage fixed system paths, not the
+            # configurable document directory
+            "cron-persistence": ("CronPersistenceSynthetic", PLUGIN_DIR),
+            "log-tamper": ("LogTamperSynthetic", TAMPER_LOG_DIR),
+        }.get(cfg.scenario, ("LockBitSynthetic", cfg.target_dir))
         gt = GroundTruth(
             start_ns=start,
             end_ns=end,
-            attack_family="LockBitSynthetic",
-            target_path=cfg.target_dir,
+            attack_family=family,
+            target_path=tgt,
             platform="synthetic",
             scale=f"{cfg.num_target_files}f",
         )

@@ -258,6 +258,9 @@ def format_report(bundle: dict, tail: Optional[int] = None) -> str:
 
     lines.append("")
     lines.extend(fleet_section(bundle))
+
+    lines.append("")
+    lines.extend(respond_section(bundle))
     return "\n".join(lines)
 
 
@@ -368,6 +371,50 @@ def fleet_section(bundle: dict) -> List[str]:
         lines.append("  per-replica headroom at last scale decision: "
                      + " ".join(f"{k}={_num(v)}"
                                 for k, v in sorted(per.items())))
+    return lines
+
+
+#: journal kinds the respond section reads
+RESPOND_KINDS = ("incident_enqueued", "plan_emitted", "plan_verified",
+                 "plan_rejected", "rollback_step_failed")
+
+
+def respond_section(bundle: dict) -> List[str]:
+    """The incident-response report over a bundle's journal tail
+    (docs/response.md): queue admissions/evictions, the plan ledger
+    (emitted vs verified vs rejected — every reject with its journaled
+    quarantine reason), and any executor steps that failed closed.
+    Degrades to one line when the respond tier never ran."""
+    records = [r for r in bundle.get("records", [])
+               if r.kind in RESPOND_KINDS]
+    if not records:
+        return ["respond: no incident-response records in bundle "
+                "(tier not attached, or the run predates it)"]
+    by = {k: [r for r in records if r.kind == k] for k in RESPOND_KINDS}
+    dropped = [r for r in by["incident_enqueued"] if r.data.get("dropped")]
+    lines = [
+        f"respond (incident-response tail, {len(records)} records):",
+        f"  incidents: {len(by['incident_enqueued']) - len(dropped)} "
+        f"enqueued, {len(dropped)} evicted (queue_full); plans: "
+        f"{len(by['plan_emitted'])} emitted → "
+        f"{len(by['plan_verified'])} verified, "
+        f"{len(by['plan_rejected'])} rejected"]
+    for r in by["plan_rejected"][-5:]:
+        lines.append(
+            f"  rejected {r.stream or '-'} w{r.data.get('window_id', '-')}"
+            f": {r.data.get('reason', '-')}")
+    for r in by["rollback_step_failed"][-5:]:
+        lines.append(
+            f"  executor refused {r.data.get('rel', '-')}: "
+            f"{r.data.get('reason', '-')}")
+    latest = by["plan_verified"][-1] if by["plan_verified"] else None
+    if latest:
+        lines.append(
+            f"  last verified plan: {latest.stream or '-'} "
+            f"w{latest.data.get('window_id', '-')} "
+            f"actions={latest.data.get('actions', '-')} "
+            f"files_restored={latest.data.get('files_restored', '-')} "
+            f"replay_ops={latest.data.get('replay_ops', '-')}")
     return lines
 
 

@@ -48,6 +48,18 @@ Record kinds in use (producers in parentheses):
     fleet_shed        admission shed a budget-burning stream's window
                       under pressure, with the burn ranking snapshot
                       (serve/service; fleet/controller)
+    incident_enqueued a WindowAlert cleared respond admission and entered
+                      the incident queue (respond/router); queue-full
+                      evictions land as drops with reason
+    plan_emitted      the batched planner produced an UndoPlan for an
+                      incident, pre-verification (respond/router)
+    plan_verified     sandbox replay approved the plan: it is surfaced
+                      (respond/verify)
+    plan_rejected     verification refused the plan — quarantined with the
+                      gate's reason, never surfaced (respond/verify)
+    rollback_step_failed  the executor refused one plan step fail-closed:
+                      path escaped the sandbox root or the snapshot blob's
+                      pre-image hash mismatched (rollback/executor)
     exception         uncaught exception captured by the crash hook
     bundle            a flight-recorder bundle was written (flight/recorder)
 
@@ -94,6 +106,8 @@ KNOWN_KINDS = (
     "capacity_saturation", "compile", "compile_cache_prune",
     "profile_capture", "profile_failed", "train_start", "train_done",
     "train_health", "fleet_scale", "fleet_rebalance", "fleet_shed",
+    "incident_enqueued", "plan_emitted", "plan_verified", "plan_rejected",
+    "rollback_step_failed",
     "exception", "bundle",
 )
 

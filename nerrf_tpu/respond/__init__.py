@@ -1,0 +1,40 @@
+"""Online incident-response tier: live detections → batched MCTS planning
+→ sandbox-verified undo plans (docs/response.md).
+
+The serve plane detects; this package answers.  Alerts crossing the
+demux's calibrated-severity gate become incidents in a bounded queue, a
+micro-batcher packs them into padded root-state buckets for one vmapped
+`DeviceMCTS` program per batch slot (warmed through the CompileCache —
+zero recompiles after warmup), and every emitted plan is replayed through
+the rollback sandbox gate before anything is surfaced.  Unverifiable
+plans are quarantined with a journaled reason, never surfaced.
+"""
+
+from nerrf_tpu.respond.config import RespondConfig
+from nerrf_tpu.respond.incidents import Incident, IncidentQueue
+from nerrf_tpu.respond.planner import (BatchedDeviceMCTS,
+                                       respond_program_key)
+from nerrf_tpu.respond.router import ResponseRouter
+from nerrf_tpu.respond.scenarios import (FAMILIES, ScheduledIncident,
+                                         StagedIncident, schedule,
+                                         sim_config, stage_incident)
+from nerrf_tpu.respond.verify import (PlanVerifier, VerifiedPlan,
+                                      VerifyContext)
+
+__all__ = [
+    "RespondConfig",
+    "Incident",
+    "IncidentQueue",
+    "BatchedDeviceMCTS",
+    "respond_program_key",
+    "ResponseRouter",
+    "FAMILIES",
+    "ScheduledIncident",
+    "StagedIncident",
+    "schedule",
+    "sim_config",
+    "stage_incident",
+    "PlanVerifier",
+    "VerifiedPlan",
+    "VerifyContext",
+]

@@ -1,0 +1,326 @@
+"""Batched incident planning: vmapped DeviceMCTS over incident roots.
+
+`DeviceMCTS` runs one search as one XLA program; at serve scale incidents
+arrive faster than sequential `plan()` calls amortize their dispatch, and
+a pod chip sits mostly idle during any single small search.  The Anakin
+answer (Podracer, arXiv 2104.06272) is to colocate and *vectorize*: vmap
+the whole select→expand→evaluate→backup program over a batch of incident
+root states, so B searches advance in lockstep inside one executable.
+
+This module adds NO search logic.  `_batched_programs` wraps the existing
+`_programs` closures — the single-incident planner's exact init/search
+functions — in ``jax.jit(jax.vmap(...))``, with the per-incident `_Ctx`
+batched and the simulation count broadcast.  A batch slot is therefore
+bit-for-bit the single planner's computation with a leading batch axis,
+which is what makes the bench's B=1 parity gate meaningful.
+
+Compile discipline mirrors serve's bucket ladder: incidents are padded
+into (file, proc) shape buckets by `DeviceMCTS` itself, batches are padded
+up a fixed batch-slot ladder, and each (bucket, slot) executable resolves
+through the `CompileCache` (`respond_program_key`) at warmup — zero
+recompiles after warmup, counted honestly by `recompiles` when traffic
+somehow escapes the ladder (admission clamps make that a bug, not a
+tail case).  Pad slots re-run the first incident's context on a
+pre-stopped root state: terminal at the root, the search visits it M
+times without growing the tree — constant work, no output.
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+from typing import Any, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from nerrf_tpu.planner.device_mcts import DeviceMCTS, _Ctx, _programs
+from nerrf_tpu.planner.domain import UndoDomain, UndoPlan
+from nerrf_tpu.planner.mcts import MCTSConfig, extract_plan
+from nerrf_tpu.utils import sync_result
+
+
+def respond_program_key(F: int, P: int, batch: int, cfg: MCTSConfig,
+                        max_steps: float = 64.0) -> dict:
+    """Caller-side CompileCache key material for one (bucket, slot) search
+    executable — the respond counterpart of aot.serve_program_key.  The
+    aval signature already pins shapes; this pins the search *semantics*
+    baked into the traced program as constants (PUCT exploration weight,
+    the episode step-horizon, sim budget via M) so a config change can
+    never reuse a stale executable.  Audited by the `cache-key-coverage`
+    deep rule (analysis/programs/entries.py: respond_search)."""
+    return {
+        "kind": "respond_search",
+        "bucket": f"{F}f/{P}p",
+        "batch": int(batch),
+        "sims": int(cfg.num_simulations),
+        "c_puct": float(cfg.c_puct),
+        "max_steps": float(max_steps),
+    }
+
+
+@functools.lru_cache(maxsize=32)
+def _batched_programs(F: int, P: int, M: int, max_steps: float,
+                      c_puct: float, value_apply, batch: int):
+    """(init_batch, search_batch) for one (shape-bucket, value-fn, B)
+    signature: the single-incident `_programs` closures vmapped over the
+    leading incident axis.  ``value_params`` ride unbatched (one shared
+    value function for the whole tier — per-incident fitting is an
+    offline luxury the online path does not pay for)."""
+    base = _programs(F, P, M, max_steps, c_puct, value_apply)
+    ctx_axes = _Ctx(file_scores=0, file_loss=0, proc_scores=0, prior=0,
+                    real=0, value_params=None)
+    init_b = jax.jit(jax.vmap(base.init_tree))
+    search_b = jax.jit(jax.vmap(base.search_chunk,
+                                in_axes=(0, None, ctx_axes)))
+    return init_b, search_b
+
+
+def _stack_ctx(ctxs: Sequence[_Ctx]) -> _Ctx:
+    """Batch per-incident contexts along a new leading axis; value params
+    are shared (identical object per _batched_programs contract), so the
+    first incident's ride along unbatched."""
+    return _Ctx(
+        file_scores=jnp.stack([c.file_scores for c in ctxs]),
+        file_loss=jnp.stack([c.file_loss for c in ctxs]),
+        proc_scores=jnp.stack([c.proc_scores for c in ctxs]),
+        prior=jnp.stack([c.prior for c in ctxs]),
+        real=jnp.stack([c.real for c in ctxs]),
+        value_params=ctxs[0].value_params,
+    )
+
+
+def _bucket_dims(d: UndoDomain) -> Tuple[int, int, float]:
+    """(Fp, Pp, max_steps): the compile-bucket signature of one domain,
+    without paying a DeviceMCTS construction to learn it."""
+    return (DeviceMCTS._bucket(d.F, DeviceMCTS.FILE_BUCKET_FLOOR),
+            DeviceMCTS._bucket(d.P, DeviceMCTS.PROC_BUCKET_FLOOR),
+            float(d.max_steps))
+
+
+def _pack_batch(domains: Sequence[UndoDomain], F: int, P: int,
+                pad_to: int, value_params) -> Tuple[jnp.ndarray, _Ctx]:
+    """Host-side wave assembly: (padded roots [B, D], batched _Ctx) built
+    directly from the domains in numpy, one device transfer per field —
+    the Anakin discipline (pack on host, cross the link once).  Per-lane
+    layout is bit-identical to DeviceMCTS.__post_init__/_pad_state (pad
+    files born done, pad procs born killed, zero scores — the parity
+    tests pin this).  Lanes past ``len(domains)`` repeat lane 0 with the
+    root pre-stopped: terminal at node 0, so a pad lane's search visits a
+    dead root M times and grows nothing — constant work, no output."""
+    n, B, D = len(domains), pad_to, F + P + 3
+    fs = np.zeros((B, F), np.float32)
+    fl = np.zeros((B, F), np.float32)
+    ps = np.zeros((B, P), np.float32)
+    pr = np.zeros((B, F + P + 1), np.float32)
+    real = np.zeros((B, 2), np.float32)
+    roots = np.ones((B, D), np.float32)
+    for i in range(B):
+        d = domains[i] if i < n else domains[0]
+        f, p = d.F, d.P
+        fs[i, :f] = d.file_scores
+        fl[i, :f] = d.file_loss_mb
+        ps[i, :p] = d.proc_scores
+        dp = d.priors()
+        pr[i, :f] = dp[:f]
+        pr[i, F:F + p] = dp[f:f + p]
+        pr[i, -1] = dp[-1]
+        real[i] = (f, p)
+        s = d.initial_state()
+        roots[i, :f] = s[:f]
+        roots[i, F:F + p] = s[f:f + p]
+        roots[i, F + P:] = s[f + p:]
+    roots[n:, -1] = 1.0  # pad lanes: root already stopped
+    ctx = _Ctx(file_scores=jnp.asarray(fs), file_loss=jnp.asarray(fl),
+               proc_scores=jnp.asarray(ps), prior=jnp.asarray(pr),
+               real=jnp.asarray(real), value_params=value_params)
+    return jnp.asarray(roots), ctx
+
+
+def _action_map(F: int, P: int, f: int, p: int) -> np.ndarray:
+    """Domain action index → padded action index (files | procs | stop) —
+    DeviceMCTS._action_map without the instance."""
+    return np.concatenate(
+        [np.arange(f), F + np.arange(p), [F + P]]).astype(np.int64)
+
+
+class BatchedDeviceMCTS:
+    """The respond tier's planner: one vmapped search program per batch
+    slot, warmed through the CompileCache at start.
+
+    ``value_apply``/``value_params`` follow DeviceMCTS's preferred pure
+    form and are SHARED across all incidents in a batch (None = the
+    closed-form heuristic, the online default — bit-par with the offline
+    planner run the same way)."""
+
+    def __init__(self, cfg: Optional[MCTSConfig] = None,
+                 batch_slots: Sequence[int] = (1, 2, 4, 8),
+                 value_apply=None, value_params=None,
+                 cache=None, registry=None) -> None:
+        if registry is None:
+            from nerrf_tpu.observability import DEFAULT_REGISTRY
+
+            registry = DEFAULT_REGISTRY
+        self.cfg = cfg or MCTSConfig()
+        self.batch_slots = tuple(sorted(set(int(b) for b in batch_slots)))
+        if not self.batch_slots or self.batch_slots[0] < 1:
+            raise ValueError(f"bad batch_slots: {batch_slots}")
+        self.value_apply = value_apply
+        self.value_params = value_params if value_apply is not None else ()
+        self._cache = cache
+        self._reg = registry
+        # (F, P, max_steps, B) → compiled search executable (or live jit)
+        self._warmed: dict = {}
+        # (F, P, max_steps) → prototype DeviceMCTS for program resolution;
+        # plan_batch never constructs per-incident planners (host packing
+        # in _pack_batch is the whole per-incident cost)
+        self._protos: dict = {}
+        self._recompiles = 0
+        self.warmup_info: List[dict] = []
+
+    # -- warmup ------------------------------------------------------------
+
+    def _slot_for(self, n: int) -> int:
+        for b in self.batch_slots:
+            if n <= b:
+                return b
+        return self.batch_slots[-1]
+
+    def _programs_for(self, dm: DeviceMCTS, B: int):
+        """Resolve (init, search) for one prototype planner + batch slot,
+        through the CompileCache when one is bound."""
+        dims = dm._dims
+        key = (dims["F"], dims["P"], float(dm.domain.max_steps), B)
+        init_b, search_b = _batched_programs(
+            dims["F"], dims["P"], self.cfg.num_simulations + 1,
+            float(dm.domain.max_steps), float(self.cfg.c_puct),
+            dm.value_apply, B)
+        if key in self._warmed:
+            return init_b, self._warmed[key]
+        search = search_b
+        if self._cache is not None:
+            roots = jnp.stack(
+                [jnp.asarray(dm._pad_state(dm.domain.initial_state()))] * B)
+            tree = init_b(roots)
+            ctx = _stack_ctx([dm._ctx] * B)
+            search, info = self._cache.load_or_compile(
+                search_b, (tree, jnp.asarray(1, jnp.int32), ctx),
+                program=f"respond_search[{dims['F']}f/{dims['P']}p/b{B}]",
+                extra=respond_program_key(dims["F"], dims["P"], B, self.cfg,
+                                          float(dm.domain.max_steps)))
+            self.warmup_info.append(
+                {"bucket": f"{dims['F']}f/{dims['P']}p", "batch": B,
+                 "source": info.source, "seconds": round(info.seconds, 3)})
+        return init_b, search
+
+    def warmup_for(self, num_files: int, num_procs: int,
+                   max_steps: int = 64) -> float:
+        """Compile (or cache-load) every batch slot's executable for the
+        bucket covering (num_files, num_procs); returns seconds.  The
+        resident daemon's boot step — after this, planning any incident
+        the admission clamps allow hits a warm program."""
+        t0 = time.perf_counter()
+        dm = DeviceMCTS.warmup_for(
+            num_files, num_procs, self.cfg, value_apply=self.value_apply,
+            value_params=self.value_params, max_steps=max_steps)
+        dims = dm._dims
+        self._protos[(dims["F"], dims["P"],
+                      float(dm.domain.max_steps))] = dm
+        for B in self.batch_slots:
+            init_b, search = self._programs_for(dm, B)
+            roots = jnp.stack(
+                [jnp.asarray(dm._pad_state(dm.domain.initial_state()))] * B)
+            tree = init_b(roots)
+            ctx = _stack_ctx([dm._ctx] * B)
+            # execute one 1-sim chunk: compile-AND-run proof, same gate as
+            # DeviceMCTS.warmup
+            sync_result(search(tree, jnp.asarray(1, jnp.int32), ctx))
+            self._warmed[(dims["F"], dims["P"],
+                          float(dm.domain.max_steps), B)] = search
+        return time.perf_counter() - t0
+
+    @property
+    def recompiles(self) -> int:
+        """Searches that ran outside the warmed (bucket, slot) set."""
+        return self._recompiles
+
+    # -- planning ----------------------------------------------------------
+
+    def plan_batch(self, domains: Sequence[UndoDomain]) -> List[UndoPlan]:
+        """Plan every domain in one (or a few) vmapped searches.
+
+        All domains must land in ONE (file, proc) shape bucket — the
+        admission clamps guarantee it for router traffic; mixed-bucket
+        callers get a loud error rather than a silent recompile storm.
+        Counts above the largest batch slot are processed in slot-sized
+        waves."""
+        if not domains:
+            return []
+        dims0 = _bucket_dims(domains[0])
+        for d in domains[1:]:
+            got = _bucket_dims(d)
+            if got != dims0:
+                raise ValueError(
+                    f"mixed shape buckets in one batch: {got} vs {dims0} "
+                    "(clamp domains at admission — RespondConfig.max_files/"
+                    "max_procs)")
+        out: List[UndoPlan] = []
+        top = self.batch_slots[-1]
+        for i in range(0, len(domains), top):
+            out.extend(self._plan_wave(list(domains[i:i + top]), dims0))
+        return out
+
+    def _plan_wave(self, domains: List[UndoDomain],
+                   dims: Tuple[int, int, float]) -> List[UndoPlan]:
+        cfg = self.cfg
+        F, P, max_steps = dims
+        n = len(domains)
+        B = self._slot_for(n)
+        key = (F, P, max_steps, B)
+        if key not in self._warmed:
+            # honesty counter: this wave compiles a fresh executable — the
+            # zero-recompile contract says warmup should have covered it
+            self._recompiles += 1
+            self._reg.counter_inc(
+                "respond_recompiles_total",
+                help="batched searches that ran outside the warmed "
+                     "(bucket, batch-slot) ladder — should stay 0 after "
+                     "warmup")
+        proto = self._protos.get((F, P, max_steps))
+        if proto is None:
+            proto = DeviceMCTS(domains[0], cfg,
+                               value_apply=self.value_apply,
+                               value_params=self.value_params)
+            self._protos[(F, P, max_steps)] = proto
+        init_b, search = self._programs_for(proto, B)
+
+        t0 = time.perf_counter()
+        vp = () if self.value_params is None else self.value_params
+        roots, ctx = _pack_batch(domains, F, P, B, vp)
+        tree = init_b(roots)
+
+        # identical chunk schedule to DeviceMCTS.plan — REQUIRED for the
+        # B=1 parity contract (a different slicing of num_simulations
+        # would be a different fori_loop trip sequence)
+        done = 0
+        chunk = min(128, cfg.num_simulations)
+        while done < cfg.num_simulations:
+            m = min(chunk, cfg.num_simulations - done)
+            tree = search(tree, jnp.asarray(m, jnp.int32), ctx)
+            done += m
+            if time.perf_counter() - t0 > cfg.timeout_seconds:
+                break
+        tree = jax.device_get(tree)
+        elapsed = time.perf_counter() - t0
+
+        plans: List[UndoPlan] = []
+        for i, d in enumerate(domains):
+            amap = _action_map(F, P, d.F, d.P)
+            plans.append(extract_plan(
+                d, cfg,
+                children=tree.children[i][:, amap],
+                visits=tree.visits[i], value_sum=tree.value_sum[i],
+                is_terminal=tree.terminal[i], expanded=tree.expanded[i],
+                sims=int(tree.visits[i][0]), elapsed=elapsed, root=0))
+        return plans

@@ -18,7 +18,7 @@ from pathlib import Path
 from typing import Dict, List, Optional
 
 from nerrf_tpu.planner.domain import ActionKind, UndoPlan
-from nerrf_tpu.rollback.store import Manifest, SnapshotStore
+from nerrf_tpu.rollback.store import Manifest, SnapshotStore, sha256_file
 
 
 @dataclasses.dataclass
@@ -62,12 +62,45 @@ class RollbackExecutor:
         root: str | Path,
         ransom_ext: str = ".lockbit3",
         allow_kill: bool = False,
+        journal=None,
     ) -> None:
+        if journal is None:
+            from nerrf_tpu.flight.journal import DEFAULT_JOURNAL
+
+            journal = DEFAULT_JOURNAL
         self.store = store
         self.manifest = manifest
         self.root = Path(root)
         self.ransom_ext = ransom_ext
         self.allow_kill = allow_kill
+        self._journal = journal
+
+    def _step_unsafe(self, rel: str) -> Optional[str]:
+        """Fail-closed preconditions for one REVERT_FILE step; returns the
+        one-line refusal reason, or None when the step is safe to apply.
+
+        * path escape — a manifest rel like ``../x`` (hostile or corrupted
+          manifest) would make restore/unlink write OUTSIDE the sandbox
+          root; every path this step will touch must resolve under root.
+        * pre-image mismatch — the store blob about to be written must
+          hash to the digest the manifest promises; a corrupted or
+          tampered blob must never reach the victim tree (restore-then-
+          verify would catch it AFTER the damage is done).
+        """
+        digest = self.manifest.files[rel][0]
+        root = self.root.resolve()
+        for candidate in (self.root / rel, self.root / (rel + self.ransom_ext)):
+            # resolve the PARENT (the leaf may not exist yet): symlinked or
+            # dot-dotted components both normalize away here
+            resolved = candidate.parent.resolve() / candidate.name
+            if not resolved.is_relative_to(root):
+                return f"path escapes sandbox root: {candidate}"
+        blob = self.store.dir / "blobs" / digest
+        if not blob.is_file():
+            return f"snapshot blob missing: {digest[:12]}"
+        if sha256_file(blob) != digest:
+            return f"pre-image hash mismatch: blob {digest[:12]} is corrupt"
+        return None
 
     def _rel_of(self, path: str) -> Optional[str]:
         """Map a planned (possibly ransom-named) path to a manifest entry.
@@ -97,6 +130,19 @@ class RollbackExecutor:
                 if rel is None:
                     rep.files_skipped += 1
                     rep.details.append({"target": action.target, "result": "no-snapshot"})
+                    continue
+                unsafe = self._step_unsafe(rel)
+                if unsafe is not None:
+                    # fail THIS step closed and keep executing the plan:
+                    # one bad step must not strand the other victims
+                    # mid-restore, and the refusal is journaled so the
+                    # flight/doctor planes can see why a restore shrank
+                    rep.files_failed += 1
+                    rep.details.append(
+                        {"target": action.target, "result": f"refused:{unsafe}"})
+                    self._journal.record(
+                        "rollback_step_failed", target=action.target,
+                        rel=rel, reason=unsafe)
                     continue
                 try:
                     restored = self.store.restore_file(self.manifest, rel, self.root)

@@ -44,6 +44,24 @@ class WindowAlert:
     # an alert is joinable to its batch's span tree, journal records and
     # SLO exemplars — alerts are no longer anonymous once demuxed
     trace_id: str = ""
+    # calibrated severity in [0, 1]: how far max_prob sits above the
+    # operating threshold, normalized by the remaining headroom
+    # ((max_prob - thr) / (1 - thr)).  Computed ONCE at the demux boundary
+    # (service._on_scored) so the alert sink's consumers and the respond
+    # tier's admission gate read the same number instead of re-deriving
+    # severity from the raw score with threshold assumptions of their own.
+    severity: float = 0.0
+
+
+def calibrated_severity(max_prob: float, threshold: float) -> float:
+    """The one severity formula (WindowAlert.severity): fraction of the
+    headroom above the operating threshold the score consumed, clamped to
+    [0, 1].  A window exactly at threshold is severity 0; a saturated score
+    is 1 regardless of where the threshold sits — comparable across
+    deployments with different operating points."""
+    thr = min(max(float(threshold), 0.0), 1.0)
+    head = max(1.0 - thr, 1e-9)
+    return min(max((float(max_prob) - thr) / head, 0.0), 1.0)
 
 
 class AlertSink:

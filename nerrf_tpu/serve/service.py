@@ -50,7 +50,8 @@ from nerrf_tpu.pipeline import (
     finalize_detection,
 )
 from nerrf_tpu.schema import EventArrays, StringTable
-from nerrf_tpu.serve.alerts import AlertSink, WindowAlert
+from nerrf_tpu.serve.alerts import (AlertSink, WindowAlert,
+                                    calibrated_severity)
 from nerrf_tpu.serve.batcher import MicroBatcher, ScoredWindow, WindowRequest
 from nerrf_tpu.serve.config import ServeConfig, bucket_tag, select_bucket
 from nerrf_tpu.serve.windower import StreamWindower
@@ -201,6 +202,7 @@ class OnlineDetectionService:
         # records reach it through its own subscription).  One None check
         # per window when absent
         self._archive = None
+        self._respond = None
         # the background cost-registration thread (start()) + its stop
         # flag: stop() must be able to wait it out — a daemon thread
         # still inside jax tracing when the interpreter tears down is a
@@ -342,6 +344,12 @@ class OnlineDetectionService:
         workload sketches at the demux boundary (journal records reach it
         through its own subscription — docs/archive.md)."""
         self._archive = writer
+
+    def attach_respond(self, router) -> None:
+        """Bind a respond.ResponseRouter: every WindowAlert leaving the
+        demux boundary is also offered to the incident queue (the router
+        applies its own severity admission — docs/response.md)."""
+        self._respond = router
 
     @property
     def slo(self) -> SLOTracker:
@@ -1094,13 +1102,25 @@ class OnlineDetectionService:
                             else "proc",
                             int(s.node_key[i]), float(s.probs[i]))
                            for i in hot_slots[order][:16]]
-                    self.sink.emit(WindowAlert(
+                    max_prob = float(s.probs[mask].max())
+                    alert = WindowAlert(
                         stream=s.stream, window_idx=s.window_idx,
                         lo_ns=s.lo_ns, hi_ns=s.hi_ns,
-                        max_prob=float(s.probs[mask].max()), hot=hot,
+                        max_prob=max_prob, hot=hot,
                         t_admit=s.t_admit, t_scored=s.t_scored,
                         late=s.late, model_version=s.model_version,
-                        trace_id=s.trace_id))
+                        trace_id=s.trace_id,
+                        # severity is computed ONCE here, at the demux
+                        # boundary — the sink's consumers and the respond
+                        # tier's admission gate must read the same number
+                        severity=calibrated_severity(max_prob, alert_thr))
+                    self.sink.emit(alert)
+                    if self._respond is not None:
+                        # online incident response: the router applies its
+                        # own severity admission + bounded queue; inside
+                        # the fail-open block — planning must never wedge
+                        # the ledger resolution below
+                        self._respond.offer_alert(alert)
             except Exception as e:  # noqa: BLE001 — demux must resolve
                 self._journal.record(
                     "demux_drop", stream=s.stream, window_id=s.window_idx,

@@ -39,8 +39,8 @@ ORDER = ["index", "quick-start", "architecture", "models", "kernel-paths",
          "planner", "rollback", "ingest", "scaling", "configuration",
          "serving", "model-lifecycle", "compile-cache", "operations",
          "device-efficiency", "flight-recorder", "quality",
-         "training-health", "archive", "tuning", "fleet", "chaos",
-         "static-analysis", "benchmarks"]
+         "training-health", "archive", "tuning", "fleet", "response",
+         "chaos", "static-analysis", "benchmarks"]
 
 _CSS = """
 :root { --fg:#1a1f24; --bg:#ffffff; --accent:#0b63c5; --muted:#5a6572;

@@ -97,6 +97,17 @@ timeout 560 env JAX_PLATFORMS=cpu python benchmarks/run_train_health_bench.py \
     --smoke > "$WORK/train_health_smoke.json"
 echo "e2e: trainwatch divergence smoke gates pass"
 
+# pre-flight: respond smoke — the incident-response tier end to end:
+# all four adversarial families staged on disk, detected on the live
+# router, planned in vmapped batches (B=1 bit-identical to the offline
+# planner, zero recompiles after warmup), every plan sandbox-verified
+# before surfacing and the contextless incident quarantined with a
+# journaled reason (docs/response.md).  Pinned to CPU: the whole
+# detect→plan→verify loop must hold on a tunnel-wedged host.
+timeout 560 env JAX_PLATFORMS=cpu python benchmarks/run_respond_bench.py \
+    --smoke > "$WORK/respond_smoke.json"
+echo "e2e: respond smoke gates pass"
+
 # pre-flight: archive smoke — the telemetry archive plane end to end on
 # the real serve path: a short serve run spools journal + metrics +
 # workload sketches into crash-safe segments, then `nerrf report` must

@@ -75,6 +75,18 @@ then
   exit 1
 fi
 log "pre-flight: trainwatch divergence gates pass"
+# pre-flight: respond smoke on CPU — the incident-response tier end to
+# end: four adversarial families staged, detected, planned in vmapped
+# batches (B=1 bit-parity, zero recompiles), every plan sandbox-verified
+# or quarantined with a journaled reason (docs/response.md); runs
+# BEFORE any tunnel time
+if ! timeout 560 env JAX_PLATFORMS=cpu python benchmarks/run_respond_bench.py \
+  --smoke > /tmp/respond_smoke.json 2>> /tmp/tpu_queue.log
+then
+  log "PRE-FLIGHT FAIL: respond smoke gates (/tmp/respond_smoke.json)"
+  exit 1
+fi
+log "pre-flight: respond smoke gates pass"
 # pre-flight: archive smoke on CPU — a short serve run with the
 # telemetry archive armed, then `nerrf report` must reconstruct the run
 # (windows scored, e2e quantiles) from the segments alone and `archive

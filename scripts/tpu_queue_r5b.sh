@@ -58,6 +58,15 @@ then
   exit 1
 fi
 log "pre-flight: trainwatch divergence gates pass"
+# same respond pre-flight as tpu_queue.sh: the detect→plan→verify loop
+# proven on CPU before chip time (docs/response.md)
+if ! timeout 560 env JAX_PLATFORMS=cpu python benchmarks/run_respond_bench.py \
+  --smoke > /tmp/respond_smoke.json 2>> /tmp/tpu_queue.log
+then
+  log "PRE-FLIGHT FAIL: respond smoke gates (/tmp/respond_smoke.json)"
+  exit 1
+fi
+log "pre-flight: respond smoke gates pass"
 # same archive pre-flight as tpu_queue.sh: a short archived serve run,
 # then the offline report must reconstruct it from segments alone
 # (docs/archive.md)

@@ -224,3 +224,53 @@ def test_device_mcts_program_reuse_across_incidents():
     # and the searches still plan correctly against their own ctx
     plan = a.plan()
     assert plan.rollouts == 50
+
+
+def test_pad_unpad_roundtrip_at_bucket_boundaries():
+    """_pad_state/_unpad_state must be exact inverses for every shape near
+    a bucket edge — exactly at the floor, one under, and one over (the
+    first shape that jumps to the next power-of-two bucket).  An off-by-one
+    here silently corrupts the file/proc split inside the padded layout."""
+    from nerrf_tpu.planner import DeviceMCTS
+
+    FLOOR_F = DeviceMCTS.FILE_BUCKET_FLOOR
+    FLOOR_P = DeviceMCTS.PROC_BUCKET_FLOOR
+    cfg = MCTSConfig(num_simulations=4)
+    for F, P in [(FLOOR_F - 1, FLOOR_P - 1), (FLOOR_F, FLOOR_P),
+                 (FLOOR_F + 1, FLOOR_P + 1), (3, 1)]:
+        d = _domain(F=F, P=P)
+        dm = DeviceMCTS(d, cfg)
+        rng = np.random.default_rng(F * 1000 + P)
+        for s in (d.initial_state(),
+                  rng.uniform(0, 1, F + P + 3).astype(np.float32)):
+            padded = dm._pad_state(s)
+            assert padded.shape == (dm._dims["D"],)
+            np.testing.assert_array_equal(dm._unpad_state(padded), s)
+        # pad lanes are born inert: files done, procs killed
+        padded = dm._pad_state(d.initial_state())
+        assert np.all(padded[F:dm._dims["F"]] == 1.0)
+        assert np.all(padded[dm._dims["F"] + P:
+                             dm._dims["F"] + dm._dims["P"]] == 1.0)
+        # the action map stays a bijection into the padded action space
+        amap = dm._action_map()
+        assert len(amap) == F + P + 1 == len(set(amap.tolist()))
+        assert amap[-1] == dm._dims["F"] + dm._dims["P"]
+
+
+def test_warmup_signature_stable_across_equal_bucket_configs():
+    """Every (F, P) landing in the same shape bucket must resolve to the
+    SAME compiled entry points — the respond tier's zero-recompile
+    contract depends on warmup_for's signature covering all of them."""
+    from nerrf_tpu.planner import DeviceMCTS
+
+    cfg = MCTSConfig(num_simulations=4)
+    a = DeviceMCTS.warmup_for(10, 2, cfg)
+    b = DeviceMCTS.warmup_for(200, 12, cfg)  # same 256f/16p bucket
+    c = DeviceMCTS.warmup_for(256, 16, cfg)  # exactly at the floors
+    assert a._dims == b._dims == c._dims
+    assert a._search_chunk is b._search_chunk is c._search_chunk
+    assert a._init_tree is b._init_tree is c._init_tree
+    # one past the floor: a different bucket, a different program
+    d = DeviceMCTS.warmup_for(257, 16, cfg)
+    assert d._dims["F"] == 512
+    assert d._search_chunk is not a._search_chunk

@@ -143,6 +143,68 @@ def test_sandbox_gate_handles_nested_victim_layout(tmp_path):
     assert (sub / "a.dat").read_bytes() == b"alpha" * 1000
 
 
+def test_executor_fails_closed_on_path_escape(tmp_path):
+    """A manifest rel that resolves outside the sandbox root (hostile or
+    corrupted manifest) must refuse THAT step with a one-line journaled
+    reason — not raise, not write outside root, not strand the rest of
+    the plan."""
+    from nerrf_tpu.flight.journal import EventJournal
+    from nerrf_tpu.observability import MetricsRegistry
+
+    victim = tmp_path / "inner" / "v"
+    seed_files(victim, CFG)
+    outside = tmp_path / "inner" / "loot.dat"  # where ../loot.dat lands
+    store = SnapshotStore(tmp_path / "store")
+    m = store.snapshot(victim, "pre")
+    _, encrypted = run_file_attack(victim, CFG)
+    # graft a hostile entry reusing a legitimate blob digest
+    any_rel = next(iter(m.files))
+    m.files["../loot.dat"] = m.files[any_rel]
+    plan = _plan_for(["../loot.dat"] + [str(p) for p in encrypted])
+
+    jr = EventJournal(registry=MetricsRegistry())
+    rep = RollbackExecutor(store, m, victim, journal=jr).execute(plan)
+    assert rep.files_failed == 1 and rep.files_restored == 6
+    assert not outside.exists()  # nothing was written outside root
+    refused = [d for d in rep.details
+               if d["result"].startswith("refused:")]
+    assert len(refused) == 1
+    assert "escapes sandbox root" in refused[0]["result"]
+    recs = jr.tail(kinds=("rollback_step_failed",))
+    assert len(recs) == 1 and "escapes" in recs[0].data["reason"]
+
+
+def test_executor_fails_closed_on_corrupt_blob(tmp_path):
+    """A snapshot blob whose bytes no longer hash to the manifest digest
+    (bit rot, tampering) must never reach the victim tree: the step fails
+    closed BEFORE writing, is journaled, and the rest of the plan still
+    executes."""
+    from nerrf_tpu.flight.journal import EventJournal
+    from nerrf_tpu.observability import MetricsRegistry
+
+    victim = tmp_path / "v"
+    seed_files(victim, CFG)
+    store = SnapshotStore(tmp_path / "store")
+    m = store.snapshot(victim, "pre")
+    _, encrypted = run_file_attack(victim, CFG)
+    poisoned = encrypted[0]
+    rel = poisoned.name[: -len(CFG.ransom_ext)]
+    digest = m.files[rel][0]
+    (store.dir / "blobs" / digest).write_bytes(b"rotten")
+    before = poisoned.read_bytes()
+
+    jr = EventJournal(registry=MetricsRegistry())
+    rep = RollbackExecutor(store, m, victim, journal=jr).execute(
+        _plan_for([str(p) for p in encrypted]))
+    assert rep.files_failed == 1 and rep.files_restored == 5
+    assert not rep.verified
+    assert poisoned.read_bytes() == before  # corrupt bytes never landed
+    recs = jr.tail(kinds=("rollback_step_failed",))
+    assert len(recs) == 1
+    assert "pre-image hash mismatch" in recs[0].data["reason"]
+    assert recs[0].data["rel"] == rel
+
+
 def test_firecracker_driver_gated():
     assert not FirecrackerDriver.available()  # no KVM in this container
     with pytest.raises(RuntimeError):
