@@ -825,6 +825,41 @@ def main() -> None:
         return _smoke_or_artifact("respond", "run_respond_bench.py",
                                   "respond_bench_cpu.json", surface)
 
+    def _learn():
+        # continuous-learning tier: drift injected mid-run, the closed
+        # replay→retrain→publish loop must recover edge AUC with zero
+        # serve recompiles and bit-parity through the v1→v2 swap
+        # (docs/learning.md)
+        def surface(r):
+            prov = r.get("provenance") or {}
+            div = r.get("divergence") or {}
+            return {
+                "auc_recovery_delta": r.get("value"),
+                "v1_shifted_auc": r.get("v1_shifted_auc"),
+                "v2_shifted_auc": r.get("v2_shifted_auc"),
+                "drift_bundles": r.get("drift_bundles"),
+                "retrains_triggered": r.get("retrains_triggered"),
+                "retrain_outcome": r.get("retrain_outcome"),
+                "retrain_wall_sec": r.get("retrain_wall_sec"),
+                "replay_windows": (r.get("replay") or {}).get("windows"),
+                "lineage": r.get("versions"),
+                "live_version": r.get("live_version"),
+                "provenance_parent_version": prov.get("parent_version"),
+                "provenance_replay_fingerprint":
+                    prov.get("replay_fingerprint"),
+                "parity_bit_identical": r.get(
+                    "parity_bit_identical_to_model_detect"),
+                "recompiles_after_warmup":
+                    r.get("recompiles_after_warmup"),
+                "divergence_outcome": div.get("outcome"),
+                "backend": r.get("backend"),
+                "smoke": r.get("smoke"),
+                "provenance": r.get("provenance_cmd"),
+            }
+
+        return _smoke_or_artifact("learn", "run_learn_bench.py",
+                                  "learn_bench_cpu.json", surface)
+
     # per-artifact isolation: one truncated/corrupt JSON on disk must not
     # silently drop the valid artifacts after it
     for key, loader in (("corpus100h", _j100), ("adversarial", _adv),
@@ -832,7 +867,8 @@ def main() -> None:
                         ("serve", _serve), ("model_swap", _swap),
                         ("chaos", _chaos), ("quality", _quality),
                         ("train_health", _train_health), ("tune", _tune),
-                        ("fleet", _fleet), ("respond", _respond)):
+                        ("fleet", _fleet), ("respond", _respond),
+                        ("learn", _learn)):
         try:
             entry = loader()
             if entry is not None:

@@ -1,0 +1,589 @@
+#!/usr/bin/env python3
+"""Closed-loop continuous-learning soak: drift → retrain → promote.
+
+Proves the learn plane's one-sentence contract on the REAL serve path:
+an injected traffic shift fires the drift trigger, the supervisor
+retrains exactly once over replay + synth experience, the candidate
+publishes with full provenance, the EXISTING shadow/canary gates promote
+it, and detection quality recovers — with the serve plane's zero-
+recompile and bit-parity contracts held through the swap.
+
+Flow (one service, one warmup):
+
+  1. train a v1 baseline on an UNSHIFTED corpus, measure its edge
+     ROC-AUC on held-out unshifted AND shifted eval sets, stamp its
+     quality reference profile, publish + promote v1;
+  2. serve unshifted traffic (leg A), then the same streams with
+     ``SimConfig.drift`` injected (leg B): trailing PSI breaches, the
+     flight recorder dumps exactly one ``quality_drift`` bundle, the
+     supervisor debounces it and launches exactly ONE retrain over the
+     replay buffer (fed live at the demux seam, oracle tp dispositions
+     joined by trace_id) mixed with a drift-matched synth corpus;
+  3. the candidate publishes into the lineage with provenance (trigger
+     seq, replay fingerprint, parent version) and continued shifted
+     traffic (leg C) drives shadow scoring → guardrails → canary →
+     auto-promote to v2;
+  4. v2's edge AUC on the held-out SHIFTED eval set must recover to
+     within tolerance of v1's unshifted baseline, and a final
+     single-stream leg must stay bit-identical to offline
+     ``model_detect`` under the promoted weights;
+  5. a separate divergence leg (absurd learning rate) proves the abort
+     path: trainwatch halts the run, ``retrain_aborted`` is journaled,
+     and NOTHING is published.
+
+    python benchmarks/run_learn_bench.py           # 3 streams
+    python benchmarks/run_learn_bench.py --smoke   # 2 streams, shorter
+    python benchmarks/run_learn_bench.py --out results/learn_bench_cpu.json
+
+Prints ONE JSON line (the artifact); exit 1 if any gate fails.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+BUCKET = (256, 512, 128)
+DRIFT = 0.8
+#: recovery tolerance: v2's shifted-eval edge AUC must land within this
+#: of v1's unshifted-eval baseline (the quality the fleet had before the
+#: world moved)
+AUC_TOL = 0.10
+
+
+def run(streams: int = 3, sim_seconds: float = 120.0,
+        smoke: bool = False, work: str | None = None,
+        log=lambda *a: print(*a, file=sys.stderr, flush=True)) -> dict:
+    """Importable harness body (the slow-marked tier-1 smoke calls this
+    in-process).  Returns the artifact dict.  ``work`` pins the working
+    directory (kept, and v1 training resumes from its checkpoint — the
+    elastic trainer's flat-step resume makes reruns cheap)."""
+    if smoke:
+        streams, sim_seconds = 2, 90.0
+    log = log or (lambda *a: None)
+    import jax
+
+    from nerrf_tpu.data.loaders import Trace
+    from nerrf_tpu.data.synth import SimConfig, simulate_trace
+    from nerrf_tpu.flight import FlightConfig, FlightRecorder
+    from nerrf_tpu.flight.journal import EventJournal
+    from nerrf_tpu.ingest.service import TraceReplayServer, TrackerClient
+    from nerrf_tpu.learn import (
+        ReplayConfig,
+        ReplayWriter,
+        RetrainConfig,
+        RetrainSupervisor,
+        append_disposition,
+        iter_replay,
+        replay_stats,
+    )
+    from nerrf_tpu.models import JointConfig, NerrfNet
+    from nerrf_tpu.observability import MetricsRegistry
+    from nerrf_tpu.pipeline import model_detect
+    from nerrf_tpu.quality import (
+        QualityConfig,
+        QualityMonitor,
+        build_reference_profile,
+    )
+    from nerrf_tpu.registry import ModelManager, ModelRegistry
+    from nerrf_tpu.registry.config import RegistryConfig
+    from nerrf_tpu.serve import OnlineDetectionService, ServeConfig, bucket_tag
+    from nerrf_tpu.train.checkpoint import save_checkpoint
+    from nerrf_tpu.train.data import build_dataset
+    from nerrf_tpu.train.elastic import train_elastic
+    from nerrf_tpu.train.loop import TrainConfig, evaluate, make_eval_fn
+    from nerrf_tpu.trainwatch.monitor import TrainHealthConfig
+
+    backend = jax.default_backend()
+    # batch 4 keeps a CPU train step ~5s; the task separates easily, so
+    # step counts stay small (the TPU queue runs the same shape)
+    train_batch = 4
+    v1_steps = 24 if smoke else 40
+    retrain_steps = 40 if smoke else 60
+    cfg = ServeConfig(
+        buckets=(BUCKET,), batch_size=8, batch_close_sec=0.1,
+        window_sec=15.0, stride_sec=5.0,
+        stream_queue_slots=512, alert_queue_slots=4096,
+        window_deadline_sec=2.0)
+    ds_cfg = cfg.dataset_config(BUCKET)
+    model_cfg = JointConfig().small
+    model = NerrfNet(model_cfg)
+    keep_work = work is not None
+    if work is None:
+        work = tempfile.mkdtemp(prefix="nerrf-learn-bench-")
+    else:
+        os.makedirs(work, exist_ok=True)
+        # a pinned work dir is for rerun iteration: the registry and
+        # replay buffer must still start empty (v1-train resumes)
+        for sub in ("registry", "registry-div", "replay", "flight",
+                    "retrain", "retrain-div", "v1", "v1-div"):
+            shutil.rmtree(os.path.join(work, sub), ignore_errors=True)
+
+    def sim(seed: int, drift: float, attack: bool) -> "SimConfig":
+        return SimConfig(duration_sec=sim_seconds, attack=attack,
+                         attack_start_sec=sim_seconds / 3,
+                         num_target_files=4, benign_rate_hz=6.0,
+                         seed=seed, drift=drift)
+
+    # -- v1 baseline: trained on the UNSHIFTED world ------------------------
+    t0 = time.perf_counter()
+    train_ds = build_dataset(
+        [simulate_trace(sim(3000 + i, 0.0, attack=(i % 2 == 0)))
+         for i in range(4)], ds_cfg)
+    r1 = train_elastic(
+        train_ds,
+        cfg=TrainConfig(model=model_cfg, batch_size=train_batch,
+                        num_steps=v1_steps, seed=1),
+        ckpt_dir=Path(work) / "v1-train", save_every=v1_steps, log=None)
+    params_v1 = r1.state.params
+    log(f"[learn-bench] v1 trained ({v1_steps} steps, "
+        f"{time.perf_counter() - t0:.1f}s)")
+
+    # held-out eval sets, seeds disjoint from training and serving
+    eval_fn = make_eval_fn(model)
+    eval_unshifted = build_dataset(
+        [simulate_trace(sim(9100 + i, 0.0, attack=True)) for i in range(2)],
+        ds_cfg)
+    eval_shifted = build_dataset(
+        [simulate_trace(sim(9200 + i, DRIFT, attack=True))
+         for i in range(2)], ds_cfg)
+
+    def auc(params, ds) -> float:
+        return float(evaluate(eval_fn, params, ds, cfg.batch_size)
+                     ["edge_auc"])
+
+    v1_unshifted_auc = auc(params_v1, eval_unshifted)
+    v1_shifted_auc = auc(params_v1, eval_shifted)
+    log(f"[learn-bench] v1 edge AUC: unshifted {v1_unshifted_auc:.3f}, "
+        f"shifted {v1_shifted_auc:.3f}")
+
+    profile = build_reference_profile(
+        params_v1, model,
+        [simulate_trace(sim(500 + i, 0.0, attack=(i % 2 == 0)))
+         for i in range(4)],
+        ds_cfg=ds_cfg,
+        threshold=(cfg.threshold if cfg.threshold is not None else 0.5),
+        log=log)
+
+    registry = MetricsRegistry(namespace="lbench")
+    journal = EventJournal(capacity=16384, registry=registry)
+    store = ModelRegistry(Path(work) / "registry", journal=journal)
+    save_checkpoint(Path(work) / "v1", params_v1, model_cfg)
+    # publish v1 WITH its reference profile sidecar: the model manager
+    # re-binds the live version's profile at attach and at every swap, so
+    # a profile set only on the service object would be wiped to None
+    # (profile-less version → silent monitor → no drift trigger, ever)
+    from nerrf_tpu.quality import PROFILE_FILENAME
+
+    (Path(work) / "v1" / PROFILE_FILENAME).write_text(
+        json.dumps(profile.to_dict()))
+    store.publish("default", Path(work) / "v1", source="learn-bench v1")
+    store.promote("default", 1)
+
+    # -- serve plane: manager + quality + flight + learn --------------------
+    # a retrained model LEGITIMATELY disagrees with its drifted parent, so
+    # the guardrail disagreement cuts are opened wide — this bench tests
+    # the learn loop's plumbing through shadow/canary, not the guardrail
+    # thresholds (run_swap_bench owns those)
+    mgr = ModelManager(
+        store, "default",
+        cfg=RegistryConfig(poll_sec=0.2, shadow_min_windows=8,
+                           canary_windows=4, max_disagreement_rate=1.0,
+                           max_score_drift=10.0,
+                           canary_max_disagreement=1.0),
+        registry=registry, log=log, journal=journal)
+    params, booted_cfg, _calib, _v = mgr.boot()
+    monitor = QualityMonitor(
+        QualityConfig(min_windows=10, min_scores=150, journal_every=4,
+                      # trailing = one leg's windows per stream, so by the
+                      # end of the shifted leg the trailing population is
+                      # fully shifted (and spans a full traffic cycle —
+                      # see run_quality_bench on young-set bias)
+                      trailing_windows=int((sim_seconds - cfg.window_sec)
+                                           / cfg.stride_sec) + 1,
+                      feature_trailing_windows=1024),
+        registry=registry, journal=journal)
+    window_log: list = []
+    svc = OnlineDetectionService(params, NerrfNet(booted_cfg), cfg=cfg,
+                                 registry=registry, journal=journal,
+                                 quality_monitor=monitor,
+                                 window_log=window_log)
+    mgr.attach(svc)  # binds v1's published quality profile to the monitor
+    t0 = time.perf_counter()
+    svc.start(log=log)
+    mgr.start_polling()
+    log(f"[learn-bench] service warm in {time.perf_counter() - t0:.1f}s")
+
+    replay_dir = Path(work) / "replay"
+    replay = ReplayWriter(
+        ReplayConfig(out_dir=str(replay_dir), per_stream_quota=48, seed=0),
+        registry=registry, log=log)
+    svc.attach_learn(replay)
+
+    windows_per_leg = int((sim_seconds - cfg.window_sec)
+                          / cfg.stride_sec) + 1
+    flight_cfg = dict(
+        quality_psi_breach=0.25,
+        # evidence gate well into the shifted leg: leg A contributes
+        # streams×wpl windows, so the trigger can only judge once the
+        # shifted leg dominates each stream's trailing set
+        quality_min_windows=int(streams * windows_per_leg * 1.3),
+        quality_breach_records=2, min_interval_sec=3600.0,
+        drop_burst_n=10 ** 6, p99_breach_sec=None)
+    flight = FlightRecorder(
+        FlightConfig(out_dir=os.path.join(work, "flight"), **flight_cfg),
+        registry=registry, journal=journal, slo=svc.slo,
+        info=svc.flight_info, quality=svc.quality_snapshot, log=log)
+
+    sup = RetrainSupervisor(
+        store, model_cfg,
+        cfg=RetrainConfig(
+            lineage="default", replay_dir=str(replay_dir),
+            out_dir=os.path.join(work, "retrain"),
+            debounce_triggers=1, cooldown_sec=1e9,
+            num_steps=retrain_steps, batch_size=train_batch, seed=2,
+            save_every=retrain_steps,
+            replay_limit=64, synth_traces=4, synth_seed=4200,
+            synth_duration_sec=sim_seconds, synth_drift=DRIFT,
+            synth_num_target_files=4, synth_benign_rate_hz=6.0),
+        ds_cfg=ds_cfg, registry=registry, journal=journal, log=log,
+        monitor_cfg=TrainHealthConfig(journal_every=8,
+                                      stall_after_sec=3600.0))
+
+    def leg(name: str, drift: float, seed_base: int, n: int) -> dict:
+        """Feed n streams one trace each through the wire path."""
+        servers, targets = [], []
+        for i in range(n):
+            tr = simulate_trace(sim(seed_base + 97 * i, drift,
+                                    attack=(i % 2 == 0)))
+            srv = TraceReplayServer(tr.events, tr.strings, batch_size=256)
+            port = srv.start()
+            servers.append(srv)
+            targets.append(f"127.0.0.1:{port}")
+        t0 = time.perf_counter()
+        # stream NAMES stay per-leg-unique but short-lived; the quality
+        # and replay planes key on them as independent populations, so
+        # reuse the SAME names across legs (reconnect semantics: sN)
+        runs = [svc.connect(f"s{i}", targets[i], timeout=300.0)
+                for i in range(n)]
+        for r in runs:
+            r.done.wait(timeout=600.0)
+        wall = time.perf_counter() - t0
+        out = {"leg": name, "drift": drift, "wall_seconds": round(wall, 2),
+               "stream_errors": {r.stream: repr(r.error)
+                                 for r in runs if r.error} or None,
+               "targets": targets}
+        for srv in servers:
+            srv.stop()
+        log(f"[learn-bench] leg {name}: {wall:.1f}s wall"
+            + (f", errors {out['stream_errors']}" if out["stream_errors"]
+               else ""))
+        return out
+
+    result: dict = {}
+    try:
+        leg_a = leg("unshifted", 0.0, seed_base=1000, n=streams)
+        # oracle dispositions on leg A's replay content, BEFORE the
+        # drift leg can trigger a retrain: alerted windows get a tp
+        # label (an operator would do this from the alert timeline), so
+        # the retrain's dataset exercises the trace_id label join live
+        replay.flush()
+        dispositions = 0
+        for rec in iter_replay(replay_dir):
+            if dispositions >= 8:
+                break
+            if rec.get("max_prob") is not None and rec["max_prob"] >= 0.5:
+                append_disposition(replay_dir, rec["trace_id"], "tp",
+                                   note="bench oracle: alerted window")
+                dispositions += 1
+        leg_b = leg("shifted", DRIFT, seed_base=5000, n=streams)
+
+        # the drift bundle → supervisor launch happens on the flight
+        # recorder's journal record (the trigger fires DURING leg B);
+        # wait out the retrain
+        deadline = time.monotonic() + 300
+        while time.monotonic() < deadline and sup.launches == 0:
+            time.sleep(0.25)
+        if sup.launches == 0:
+            for rec in journal.tail(kinds=("quality_stats",))[-4:]:
+                log("[learn-bench] quality_stats: "
+                    f"windows={rec.data.get('windows')} "
+                    f"score_psi={rec.data.get('worst_score_psi')} "
+                    f"feature_psi={rec.data.get('worst_feature_psi')}")
+            log(f"[learn-bench] NO retrain launch: replay="
+                f"{replay.stats()} bundles="
+                f"{len(journal.tail(kinds=('bundle',)))}")
+        sup.wait(timeout=900)
+        retrain_outcome = sup.last_outcome
+
+        # continued shifted traffic drives shadow → canary → promote
+        promote_legs = 0
+        while (svc.live_version != 2 and promote_legs < 3
+               and retrain_outcome == "published"):
+            promote_legs += 1
+            leg("promote%d" % promote_legs, DRIFT,
+                seed_base=6000 + 500 * promote_legs, n=streams)
+            t_stop = time.monotonic() + 30
+            while time.monotonic() < t_stop and svc.live_version != 2:
+                time.sleep(0.2)
+
+        # parity across the swap: one fresh shifted stream through the
+        # NOW-LIVE weights vs offline model_detect on the same bytes
+        parity = None
+        parity_version = svc.live_version
+        tr = simulate_trace(sim(9900, DRIFT, attack=True))
+        srv = TraceReplayServer(tr.events, tr.strings, batch_size=256)
+        target = f"127.0.0.1:{srv.start()}"
+        prun = svc.connect("parity0", target, timeout=300.0)
+        prun.done.wait(timeout=600.0)
+        ev, strings = TrackerClient(target).stream(timeout=60.0)
+        srv.stop()
+        params_live, _cfg_live, _cal, _ver = store.load(
+            "default", parity_version)
+        offline = model_detect(
+            Trace(events=ev, strings=strings, ground_truth=None,
+                  labels=None, name="parity0"),
+            params_live, model, ds_cfg=ds_cfg, auto_capacity=False,
+            batch_size=cfg.batch_size)
+        served = prun.result
+        parity = (
+            served is not None
+            and served.file_scores == offline.file_scores
+            and served.file_window_scores == offline.file_window_scores
+            and served.proc_scores == offline.proc_scores
+            and served.threshold == offline.threshold)
+
+        # recovery: the promoted weights on the held-out SHIFTED set
+        v2_shifted_auc = None
+        v2_unshifted_auc = None
+        status = store.status("default")
+        if store.live_version("default") == 2:
+            params_v2, _c, _cal2, _v2 = store.load("default", 2)
+            v2_shifted_auc = auc(params_v2, eval_shifted)
+            v2_unshifted_auc = auc(params_v2, eval_unshifted)
+            log(f"[learn-bench] v2 edge AUC: shifted {v2_shifted_auc:.3f} "
+                f"(v1 shifted {v1_shifted_auc:.3f}, v1 unshifted "
+                f"{v1_unshifted_auc:.3f})")
+
+        flip_clean = True
+        seen2 = False
+        for entry in window_log:
+            if entry[4] == 2:
+                seen2 = True
+            elif seen2 and entry[4] == 1:
+                flip_clean = False
+        triggered = journal.tail(kinds=("retrain_triggered",))
+        done_recs = journal.tail(kinds=("retrain_done",))
+        bundles = sorted(
+            p for p in os.listdir(os.path.join(work, "flight"))
+            if p.startswith("bundle-")) if os.path.isdir(
+            os.path.join(work, "flight")) else []
+        tag = bucket_tag(BUCKET)
+        prov = None
+        for v in status["versions"]:
+            if v["version"] == 2:
+                prov = v.get("provenance")
+        meta_prov = None
+        try:
+            meta = json.loads(
+                (store.version_dir("default", 2) / "model_config.json")
+                .read_text())
+            meta_prov = meta.get("provenance")
+        except (OSError, ValueError):
+            pass
+
+        result = {
+            "metric": "learn_closed_loop_recovery",
+            "value": (None if v2_shifted_auc is None
+                      else round(v2_shifted_auc - v1_shifted_auc, 4)),
+            "unit": "edge ROC-AUC recovery on the held-out shifted eval "
+                    f"set (tolerance {AUC_TOL} vs unshifted baseline)",
+            "backend": backend,
+            "smoke": smoke or None,
+            "streams": streams,
+            "drift": DRIFT,
+            "auc_tolerance": AUC_TOL,
+            "v1_unshifted_auc": round(v1_unshifted_auc, 4),
+            "v1_shifted_auc": round(v1_shifted_auc, 4),
+            "v2_shifted_auc": (None if v2_shifted_auc is None
+                               else round(v2_shifted_auc, 4)),
+            "v2_unshifted_auc": (None if v2_unshifted_auc is None
+                                 else round(v2_unshifted_auc, 4)),
+            "legs": {"unshifted": leg_a, "shifted": leg_b,
+                     "promote_legs": promote_legs},
+            "drift_bundles": len(bundles),
+            "bundle_trigger": (bundles[0].rsplit("-", 1)[-1]
+                               if bundles else None),
+            "retrains_triggered": len(triggered),
+            "retrain_outcome": retrain_outcome,
+            "retrain_wall_sec": (done_recs[-1].data.get("wall_sec")
+                                 if done_recs else None),
+            "retrain_steps": retrain_steps,
+            "oracle_dispositions": dispositions,
+            "replay": replay_stats(replay_dir),
+            "live_version": store.live_version("default"),
+            "versions": [v["version"] for v in status["versions"]],
+            "provenance": prov,
+            "checkpoint_meta_provenance": meta_prov,
+            "window_log_flip_clean": flip_clean,
+            "parity_bit_identical_to_model_detect": bool(parity),
+            "parity_model_version": parity_version,
+            "recompiles_after_warmup": int(registry.value(
+                "serve_recompiles_total", labels={"bucket": tag})),
+            "retrain_runs_published": int(registry.value(
+                "retrain_runs_total", labels={"outcome": "published"})),
+        }
+    finally:
+        flight.close()
+        sup.close()
+        replay.close()
+        mgr.close()
+        svc.stop()
+
+    # -- divergence leg: an absurd learning rate must abort, not publish --
+    result["divergence"] = _divergence_leg(
+        work, model_cfg, ds_cfg, params_v1, log)
+    if not keep_work:
+        shutil.rmtree(work, ignore_errors=True)
+    result["provenance_cmd"] = ("python benchmarks/run_learn_bench.py"
+                                + (" --smoke" if smoke else ""))
+    return result
+
+
+def _divergence_leg(work: str, model_cfg, ds_cfg, params_v1, log) -> dict:
+    """Isolated world proving the abort path: a retrain whose loss goes
+    non-finite is halted by trainwatch, journals ``retrain_aborted``,
+    and publishes NOTHING into the lineage."""
+    from nerrf_tpu.flight.journal import EventJournal
+    from nerrf_tpu.learn import RetrainConfig, RetrainSupervisor
+    from nerrf_tpu.observability import MetricsRegistry
+    from nerrf_tpu.registry import ModelRegistry
+    from nerrf_tpu.train.checkpoint import save_checkpoint
+    from nerrf_tpu.trainwatch.monitor import TrainHealthConfig
+
+    registry = MetricsRegistry(namespace="lbench2")
+    journal = EventJournal(capacity=4096, registry=registry)
+    store = ModelRegistry(Path(work) / "registry-div", journal=journal)
+    save_checkpoint(Path(work) / "v1-div", params_v1, model_cfg)
+    store.publish("default", Path(work) / "v1-div", source="learn-bench v1")
+    store.promote("default", 1)
+    sup = RetrainSupervisor(
+        store, model_cfg,
+        cfg=RetrainConfig(
+            lineage="default", replay_dir=str(Path(work) / "no-replay"),
+            out_dir=os.path.join(work, "retrain-div"),
+            debounce_triggers=1, cooldown_sec=1e9,
+            # the divergence injection: a learning rate no finite loss
+            # survives — params explode on step one, the forward pass
+            # overflows, and the monitor's non-finite latch must halt
+            # the run at the next checkpoint boundary
+            num_steps=20, save_every=2, learning_rate=1e12, seed=3,
+            batch_size=4, replay_limit=8, synth_traces=2, synth_seed=7700,
+            synth_duration_sec=60.0, synth_num_target_files=4,
+            synth_benign_rate_hz=6.0),
+        ds_cfg=ds_cfg, registry=registry, journal=journal, log=log,
+        monitor_cfg=TrainHealthConfig(journal_every=2,
+                                      stall_after_sec=3600.0))
+    journal.record("bundle", trigger="quality_drift", path="injected")
+    deadline = time.monotonic() + 600
+    while time.monotonic() < deadline and sup.launches == 0:
+        time.sleep(0.1)
+    sup.wait(timeout=600)
+    sup.close()
+    aborted = journal.tail(kinds=("retrain_aborted",))
+    return {
+        "outcome": sup.last_outcome,
+        "aborted_records": len(aborted),
+        "abort_reason": (aborted[-1].data.get("reason")
+                         if aborted else None),
+        "versions_after": [v["version"]
+                           for v in store.status("default")["versions"]],
+        "runs_aborted": int(registry.value(
+            "retrain_runs_total", labels={"outcome": "aborted"})),
+    }
+
+
+def gates(result: dict) -> list:
+    """Every acceptance gate, as (name, ok) — shared by main() and the
+    artifact-of-record test."""
+    div = result.get("divergence") or {}
+    v2 = result.get("v2_shifted_auc")
+    return [
+        ("no_stream_errors",
+         result["legs"]["unshifted"].get("stream_errors") is None
+         and result["legs"]["shifted"].get("stream_errors") is None),
+        ("exactly_one_drift_bundle", result["drift_bundles"] == 1),
+        ("bundle_is_quality_drift",
+         result.get("bundle_trigger") == "quality_drift"),
+        ("exactly_one_retrain", result["retrains_triggered"] == 1
+         and result["retrain_runs_published"] == 1),
+        ("retrain_published", result["retrain_outcome"] == "published"),
+        ("lineage_v1_to_v2", result["versions"] == [1, 2]
+         and result["live_version"] == 2),
+        ("provenance_in_status",
+         bool(result.get("provenance"))
+         and result["provenance"].get("parent_version") == 1
+         and result["provenance"].get("trigger_seq") is not None
+         and bool(result["provenance"].get("replay_fingerprint"))),
+        ("provenance_in_checkpoint_meta",
+         bool(result.get("checkpoint_meta_provenance"))
+         and result["checkpoint_meta_provenance"]
+         == result.get("provenance")),
+        ("replay_buffer_fed",
+         (result.get("replay") or {}).get("windows", 0) > 0),
+        ("quality_recovered",
+         v2 is not None
+         and v2 >= result["v1_unshifted_auc"] - result["auc_tolerance"]
+         and v2 >= result["v1_shifted_auc"] - 0.02),
+        ("parity_bit_identical_across_swap",
+         result.get("parity_bit_identical_to_model_detect") is True
+         and result.get("parity_model_version") == 2),
+        ("window_log_flip_clean",
+         result.get("window_log_flip_clean") is True),
+        ("zero_recompiles", result["recompiles_after_warmup"] == 0),
+        ("divergence_aborts_and_publishes_nothing",
+         div.get("outcome") == "aborted"
+         and div.get("aborted_records", 0) >= 1
+         and div.get("versions_after") == [1]
+         and div.get("runs_aborted") == 1),
+    ]
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--streams", type=int, default=3)
+    ap.add_argument("--seconds", type=float, default=120.0,
+                    help="simulated seconds of trace per stream per leg")
+    ap.add_argument("--smoke", action="store_true",
+                    help="2 streams, short traces, fewer retrain steps")
+    ap.add_argument("--out", default=None, metavar="FILE",
+                    help="also write the artifact JSON here")
+    ap.add_argument("--work", default=None, metavar="DIR",
+                    help="pin (and keep) the working directory; v1 "
+                         "training resumes from its checkpoint on rerun")
+    args = ap.parse_args(argv)
+
+    result = run(streams=args.streams, sim_seconds=args.seconds,
+                 smoke=args.smoke, work=args.work)
+    print(json.dumps(result))
+    if args.out:
+        os.makedirs(os.path.dirname(os.path.abspath(args.out)),
+                    exist_ok=True)
+        with open(args.out, "w") as f:
+            f.write(json.dumps(result, indent=2) + "\n")
+    failed = [name for name, ok in gates(result) if not ok]
+    for name in failed:
+        print(f"[learn-bench] GATE FAILED: {name}", file=sys.stderr)
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

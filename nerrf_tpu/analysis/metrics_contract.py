@@ -128,6 +128,15 @@ REQUIRED = (
     "respond_plan_seconds",
     "respond_queue_depth",
     "respond_recompiles_total",
+    # the continuous-learning plane (docs/learning.md; run_learn_bench's
+    # gates and the drift-response runbook key off these exact names —
+    # retrain_runs_total's outcome split is how an abort storm shows up
+    # on a dashboard, and retrain_active is the single-flight latch made
+    # visible)
+    "learn_replay_windows_total",
+    "learn_replay_bytes",
+    "retrain_runs_total",
+    "retrain_active",
 )
 
 _CALL = re.compile(

@@ -1520,6 +1520,55 @@ def cmd_archive(args) -> int:
             out = merge_archives(args.sources, args.out, log=_log)
             print(json.dumps(out))
             return 0
+        if args.archive_cmd == "export" and args.replay:
+            # learn-plane reader: the replay buffer → deterministic,
+            # seedable training batches (docs/learning.md).  jax-free —
+            # window lowering is pure numpy
+            from nerrf_tpu.learn import (
+                build_replay_dataset,
+                iter_replay,
+                replay_batches,
+                replay_stats,
+            )
+            from nerrf_tpu.serve.config import ServeConfig
+            from nerrf_tpu.train.data import DatasetConfig
+
+            stats = replay_stats(args.dir)
+            if not stats["windows"]:
+                _log(f"refusing to export: replay buffer {args.dir} holds "
+                     "no scored windows (serve with the learn plane "
+                     "attached first)")
+                return 1
+            bucket = None
+            if args.bucket:
+                bucket = tuple(int(x) for x in
+                               args.bucket.replace("x", ",").split(","))
+            else:
+                # shape authority from the buffer itself: replay records
+                # carry the bucket serve admission lowered them into
+                for rec in iter_replay(args.dir):
+                    if rec.get("bucket"):
+                        bucket = tuple(rec["bucket"])
+                    break
+            ds_cfg = (ServeConfig().dataset_config(bucket) if bucket
+                      else DatasetConfig())
+            ds, info = build_replay_dataset(
+                args.dir, ds_cfg, seed=args.seed, limit=args.limit)
+            batches = 0
+            if ds is not None:
+                batches = sum(1 for _ in replay_batches(
+                    ds, args.batch_size, seed=args.seed))
+            doc = {"replay_dir": str(args.dir), "bucket": list(bucket or ()),
+                   "seed": args.seed, "batch_size": args.batch_size,
+                   "batches": batches, "stats": stats, "dataset": info}
+            if args.out and ds is not None:
+                import numpy as np
+
+                np.savez_compressed(args.out, **ds.arrays)
+                _log(f"replay dataset written to {args.out} "
+                     f"({info['windows']} windows, seed {args.seed})")
+            print(json.dumps(doc, indent=2))
+            return 0
         if args.archive_cmd == "export":
             corpus = export_tune(args.dir)
             # polite refusal, not a garbage corpus: an archive with no
@@ -1551,6 +1600,27 @@ def cmd_archive(args) -> int:
         _log(f"not an archive directory: {e}")
         return 2
     return 2
+
+
+def cmd_alerts(args) -> int:
+    """Operator feedback on served alerts (docs/learning.md): label a
+    window's alert tp/fp by its trace_id.  The disposition lands twice —
+    an ``alert_disposition`` journal record (flight/archive evidence)
+    and the replay buffer's sidecar, where the `export --replay` reader
+    joins it into training labels by trace_id, last-wins."""
+    from nerrf_tpu.flight.journal import DEFAULT_JOURNAL
+    from nerrf_tpu.learn import append_disposition
+
+    if args.alerts_cmd == "label":
+        rec = append_disposition(args.replay_dir, args.trace_id,
+                                 args.label, note=args.note)
+        DEFAULT_JOURNAL.record(
+            "alert_disposition", trace_id=args.trace_id,
+            label=args.label, note=args.note,
+            replay_dir=str(args.replay_dir))
+        print(json.dumps(rec))
+        return 0
+    return 2  # pragma: no cover — argparse enforces the choices
 
 
 def cmd_tune(args) -> int:
@@ -2198,11 +2268,51 @@ def main(argv=None) -> int:
                                         "tune` cost-model fit consumes")
     ar.add_argument("dir")
     ar.add_argument("--tune", action="store_true",
-                    help="the cost-model corpus (the only export today; "
+                    help="the cost-model corpus (the default export; "
                          "the flag names the schema)")
+    ar.add_argument("--replay", action="store_true",
+                    help="read `dir` as a learn-plane replay buffer "
+                         "instead: lower its scored windows (with "
+                         "disposition labels joined by trace_id) into "
+                         "deterministic, seedable training batches "
+                         "(docs/learning.md)")
+    ar.add_argument("--seed", type=int, default=0,
+                    help="replay shuffle/batch seed (same buffer + same "
+                         "seed = bit-identical batches)")
+    ar.add_argument("--limit", type=int, default=None,
+                    help="cap the replay windows lowered (applied after "
+                         "the seeded shuffle)")
+    ar.add_argument("--batch-size", type=int, default=8,
+                    help="replay batch size (inventory only — the "
+                         "trainer slices its own)")
+    ar.add_argument("--bucket", default=None, metavar="N,E,S",
+                    help="padded shape to lower replay windows into "
+                         "(default: the bucket stamped in the buffer's "
+                         "first record)")
     ar.add_argument("--out", default=None, metavar="FILE",
-                    help="write the corpus JSON here instead of stdout")
+                    help="write the corpus JSON (or, with --replay, the "
+                         "stacked dataset .npz) here instead of stdout")
     ar.set_defaults(fn=cmd_archive)
+
+    p = sub.add_parser("alerts", help="operator feedback on served "
+                                      "alerts: tp/fp dispositions that "
+                                      "join the replay buffer's label "
+                                      "stream (docs/learning.md)")
+    alsub = p.add_subparsers(dest="alerts_cmd", required=True)
+    al = alsub.add_parser("label", help="record one disposition by "
+                                        "trace_id (journal record + "
+                                        "replay-buffer sidecar)")
+    al.add_argument("trace_id", help="the alert's trace_id (alert "
+                                     "records, `nerrf doctor` timeline)")
+    al.add_argument("label", choices=["tp", "fp"],
+                    help="true positive (the window really was an "
+                         "attack) or false positive")
+    al.add_argument("--note", default=None,
+                    help="free-text context stored with the disposition")
+    al.add_argument("--replay-dir", default="replay-buffer", metavar="DIR",
+                    help="the replay buffer whose sidecar receives the "
+                         "label (default: ./replay-buffer)")
+    al.set_defaults(fn=cmd_alerts)
 
     p = sub.add_parser("tune", help="fit a learned bucket ladder + "
                                     "per-rung kernel routing from an "

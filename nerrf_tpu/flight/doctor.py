@@ -261,6 +261,9 @@ def format_report(bundle: dict, tail: Optional[int] = None) -> str:
 
     lines.append("")
     lines.extend(respond_section(bundle))
+
+    lines.append("")
+    lines.extend(learn_section(bundle))
     return "\n".join(lines)
 
 
@@ -415,6 +418,54 @@ def respond_section(bundle: dict) -> List[str]:
             f"actions={latest.data.get('actions', '-')} "
             f"files_restored={latest.data.get('files_restored', '-')} "
             f"replay_ops={latest.data.get('replay_ops', '-')}")
+    return lines
+
+
+#: journal kinds the continuous-learning section reads
+LEARN_KINDS = ("retrain_triggered", "retrain_done", "retrain_aborted",
+               "alert_disposition")
+
+
+def learn_section(bundle: dict) -> List[str]:
+    """The continuous-learning report over a bundle's journal tail
+    (docs/learning.md): the last drift trigger that armed the
+    supervisor, every retrain's outcome, the provenance chain of the
+    last published candidate (parent version → version, replay
+    fingerprint), and operator disposition volume.  Degrades to one
+    line on bundles without learn records."""
+    records = [r for r in bundle.get("records", [])
+               if r.kind in LEARN_KINDS]
+    if not records:
+        return ["learn: no continuous-learning records in bundle "
+                "(supervisor not attached, or the run predates it)"]
+    by = {k: [r for r in records if r.kind == k] for k in LEARN_KINDS}
+    lines = [
+        f"learn (continuous-learning tail, {len(records)} records):",
+        f"  retrains: {len(by['retrain_triggered'])} triggered → "
+        f"{len(by['retrain_done'])} published, "
+        f"{len(by['retrain_aborted'])} aborted; "
+        f"dispositions: {len(by['alert_disposition'])}"]
+    last_trig = by["retrain_triggered"][-1] if by["retrain_triggered"] \
+        else None
+    if last_trig:
+        lines.append(
+            f"  last trigger: seq {last_trig.data.get('trigger_seq', '-')}"
+            f" parent v{last_trig.data.get('parent_version', '-')} "
+            f"replay {last_trig.data.get('replay_fingerprint', '-')}")
+    for r in by["retrain_aborted"][-3:]:
+        lines.append(
+            f"  aborted (trigger seq {r.data.get('trigger_seq', '-')}): "
+            f"{r.data.get('reason', '-')}")
+    done = by["retrain_done"][-1] if by["retrain_done"] else None
+    if done:
+        lines.append(
+            f"  last published: v{done.data.get('parent_version', '-')} "
+            f"→ v{done.data.get('version', '-')} "
+            f"(lineage {done.data.get('lineage', '-')}, replay "
+            f"{done.data.get('replay_fingerprint', '-')}, "
+            f"{_num(done.data.get('wall_sec'))}s, edge AUC "
+            f"{_num(done.data.get('edge_auc'))}) — shadow/canary "
+            f"decide promotion")
     return lines
 
 

@@ -108,6 +108,8 @@ KNOWN_KINDS = (
     "train_health", "fleet_scale", "fleet_rebalance", "fleet_shed",
     "incident_enqueued", "plan_emitted", "plan_verified", "plan_rejected",
     "rollback_step_failed",
+    "alert_disposition", "retrain_triggered", "retrain_done",
+    "retrain_aborted",
     "exception", "bundle",
 )
 

@@ -158,6 +158,10 @@ class ModelRegistry:
                 "executables": self.executables_dir(lineage, v) is not None,
                 "quality_profile":
                     self.quality_profile(lineage, v) is not None,
+                # retrain provenance (nerrf_tpu/learn): None for a
+                # human-published version, the trigger-seq/replay-
+                # fingerprint/parent-version stamp for a supervisor one
+                "provenance": meta.get("provenance"),
             })
         return {"lineage": lineage, "live": live, "versions": versions}
 

@@ -203,6 +203,11 @@ class OnlineDetectionService:
         # per window when absent
         self._archive = None
         self._respond = None
+        # continuous-learning plane (nerrf_tpu/learn): when attached,
+        # admission tees the window's event payload (reservoir-gated)
+        # and the demux boundary joins the scores — one None check per
+        # window when absent
+        self._learn = None
         # the background cost-registration thread (start()) + its stop
         # flag: stop() must be able to wait it out — a daemon thread
         # still inside jax tracing when the interpreter tears down is a
@@ -350,6 +355,13 @@ class OnlineDetectionService:
         demux boundary is also offered to the incident queue (the router
         applies its own severity admission — docs/response.md)."""
         self._respond = router
+
+    def attach_learn(self, writer) -> None:
+        """Bind a learn.ReplayWriter: admission tees each window's event
+        payload (per-stream reservoir decides acceptance), the demux
+        boundary joins the scores, and the writer's own thread owns the
+        disk — docs/learning.md."""
+        self._learn = writer
 
     @property
     def slo(self) -> SLOTracker:
@@ -978,6 +990,19 @@ class OnlineDetectionService:
                 deadline=now + self.cfg.window_deadline_sec,
                 trace_id=trace_id,
                 nodes=int(n), edges=int(e), files=int(files))
+            try:
+                if self._learn is not None:
+                    # replay-buffer tee: the event payload must be
+                    # captured HERE (the windower's buffer behind `ev`
+                    # is reused); the writer's per-stream reservoir
+                    # decides acceptance before serializing.  Fail-open
+                    # like every observer at this seam — experience
+                    # collection must never become an admission fault
+                    self._learn.observe_admit(
+                        trace_id, base, idx, lo, hi, ev,
+                        handle.windower.strings)
+            except Exception:  # noqa: BLE001
+                pass
             shed = None
             if len(handle.live) >= self.cfg.stream_queue_slots \
                     and self._shed_pressure():
@@ -1083,6 +1108,11 @@ class OnlineDetectionService:
                         bucket_tag(s.bucket), nodes=s.nodes,
                         edges=s.edges, files=s.files, stages=stages,
                         e2e_sec=e2e)
+                if self._learn is not None:
+                    # replay-buffer join: marry the scores to the
+                    # admit-time payload by trace_id (the writer's
+                    # thread owns the disk; this is dict ops only)
+                    self._learn.observe_scored(s)
                 mask = s.node_mask.astype(bool)
                 hot_slots = (np.nonzero(mask & (s.probs >= alert_thr))[0]
                              if mask.any() else np.empty(0, np.int64))
@@ -1158,6 +1188,13 @@ class OnlineDetectionService:
                     handle.live.pop(r.window_idx, None)
                     handle.failed += 1
                     handle.cond.notify_all()
+            try:
+                if self._learn is not None:
+                    # a window the device failed never becomes training
+                    # data: drop its parked replay payload
+                    self._learn.discard(r.trace_id)
+            except Exception:  # noqa: BLE001
+                pass
             # strike/metric key: the BASE stream name — a resident
             # (follow-mode) stream renames per session (s0, s0#1, …), and
             # per-session keys would both reset its strikes on every

@@ -146,7 +146,8 @@ def _check_feature_layout(meta: dict, path: Path, keys: tuple) -> None:
 
 def save_checkpoint(path: str | Path, params, cfg: JointConfig,
                     calibration: dict | None = None,
-                    quality_profile: dict | None = None) -> None:
+                    quality_profile: dict | None = None,
+                    provenance: dict | None = None) -> None:
     meta = {
         "gnn": {"hidden": cfg.gnn.hidden, "num_layers": cfg.gnn.num_layers,
                 "dropout": cfg.gnn.dropout,
@@ -163,6 +164,12 @@ def save_checkpoint(path: str | Path, params, cfg: JointConfig,
         # belong WITH the weights: a checkpoint evaluated at someone else's
         # threshold silently changes its false-positive behavior
         meta["calibration"] = calibration
+    if provenance:
+        # retrain provenance (nerrf_tpu/learn): which trigger record,
+        # which replay-buffer content and which parent version produced
+        # these weights — stamped in the meta so `nerrf models status`
+        # answers "where did v2 come from" offline
+        meta["provenance"] = provenance
     with _atomic_dir(path) as tmp:
         with trace_span("checkpoint", kind="params"):
             with ocp.StandardCheckpointer() as ckptr:
@@ -289,7 +296,8 @@ def load_stream_checkpoint(path: str | Path):
 
 def calibrate_and_resave(path: str | Path, params, cfg: JointConfig,
                          node_loss_weight: float = 1.0,
-                         log=None) -> dict | None:
+                         log=None, provenance: dict | None = None) -> \
+        dict | None:
     """Calibrate the file detector's operating point on held-out incidents
     and re-save the checkpoint sidecar with it.  The ONE implementation of
     the calibrate-then-resave step, shared by `nerrf train-detector`
@@ -355,6 +363,8 @@ def calibrate_and_resave(path: str | Path, params, cfg: JointConfig,
         if log:
             log(f"quality profile build failed ({type(e).__name__}: {e}); "
                 "checkpoint ships without a drift baseline")
+    # provenance is threaded through the re-save: a retrained checkpoint
+    # that gets calibrated must not lose its retrain stamp to this rewrite
     save_checkpoint(path, params, cfg, calibration=calibration,
-                    quality_profile=profile)
+                    quality_profile=profile, provenance=provenance)
     return calibration

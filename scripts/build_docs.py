@@ -39,7 +39,8 @@ ORDER = ["index", "quick-start", "architecture", "models", "kernel-paths",
          "planner", "rollback", "ingest", "scaling", "configuration",
          "serving", "model-lifecycle", "compile-cache", "operations",
          "device-efficiency", "flight-recorder", "quality",
-         "training-health", "archive", "tuning", "fleet", "response",
+         "training-health", "archive", "tuning", "learning", "fleet",
+         "response",
          "chaos", "static-analysis", "benchmarks"]
 
 _CSS = """

@@ -108,6 +108,18 @@ timeout 560 env JAX_PLATFORMS=cpu python benchmarks/run_respond_bench.py \
     --smoke > "$WORK/respond_smoke.json"
 echo "e2e: respond smoke gates pass"
 
+# pre-flight: continuous-learning smoke — the learn plane closed-loop
+# on the real serve path: serve traffic feeds the replay buffer at the
+# demux seam, an injected mid-run shift fires the quality_drift trigger,
+# the supervisor retrains exactly once over replay+synth, the candidate
+# publishes with provenance and the existing shadow/canary gates promote
+# it, quality recovers on a held-out shifted eval set, and a divergent
+# retrain aborts publishing nothing (docs/learning.md).  Pinned to CPU:
+# the drift→retrain→promote edge must hold before any chip run trusts it.
+timeout 900 env JAX_PLATFORMS=cpu python benchmarks/run_learn_bench.py \
+    --smoke > "$WORK/learn_smoke.json"
+echo "e2e: continuous-learning closed-loop smoke gates pass"
+
 # pre-flight: archive smoke — the telemetry archive plane end to end on
 # the real serve path: a short serve run spools journal + metrics +
 # workload sketches into crash-safe segments, then `nerrf report` must

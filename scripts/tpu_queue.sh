@@ -87,6 +87,19 @@ then
   exit 1
 fi
 log "pre-flight: respond smoke gates pass"
+# pre-flight: continuous-learning smoke on CPU — the closed loop on the
+# real serve path: replay buffer fed at the demux seam, injected drift
+# fires the quality_drift trigger, exactly one retrain publishes with
+# provenance, the existing gates promote it, quality recovers, and a
+# divergent retrain aborts publishing nothing (docs/learning.md); runs
+# BEFORE any tunnel time
+if ! timeout 900 env JAX_PLATFORMS=cpu python benchmarks/run_learn_bench.py \
+  --smoke > /tmp/learn_smoke.json 2>> /tmp/tpu_queue.log
+then
+  log "PRE-FLIGHT FAIL: continuous-learning closed-loop gates (/tmp/learn_smoke.json)"
+  exit 1
+fi
+log "pre-flight: continuous-learning closed-loop gates pass"
 # pre-flight: archive smoke on CPU — a short serve run with the
 # telemetry archive armed, then `nerrf report` must reconstruct the run
 # (windows scored, e2e quantiles) from the segments alone and `archive

@@ -67,6 +67,16 @@ then
   exit 1
 fi
 log "pre-flight: respond smoke gates pass"
+# same continuous-learning pre-flight as tpu_queue.sh: the closed
+# drift→retrain→promote loop proven on CPU before chip time
+# (docs/learning.md)
+if ! timeout 900 env JAX_PLATFORMS=cpu python benchmarks/run_learn_bench.py \
+  --smoke > /tmp/learn_smoke.json 2>> /tmp/tpu_queue.log
+then
+  log "PRE-FLIGHT FAIL: continuous-learning closed-loop gates (/tmp/learn_smoke.json)"
+  exit 1
+fi
+log "pre-flight: continuous-learning closed-loop gates pass"
 # same archive pre-flight as tpu_queue.sh: a short archived serve run,
 # then the offline report must reconstruct it from segments alone
 # (docs/archive.md)

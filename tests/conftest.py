@@ -96,6 +96,8 @@ def make_service_shell(cfg, registry=None, journal=None):
     svc._quality = None
     svc._devtime = None
     svc._archive = None
+    svc._respond = None
+    svc._learn = None
     svc._devtime_thread = None
     svc._devtime_stop = threading.Event()
     return svc, registry

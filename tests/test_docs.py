@@ -28,7 +28,8 @@ def test_referenced_cli_commands_exist(repo_root):
     parser_cmds = {"simulate", "train-detector", "undo", "status", "serve",
                    "serve-detect", "ingest", "trace", "warmup", "doctor",
                    "models", "lint", "cache", "chaos", "profile",
-                   "quality", "archive", "report", "tune", "respond"}
+                   "quality", "archive", "report", "tune", "respond",
+                   "alerts"}
     assert referenced <= parser_cmds
     # and the parser really accepts them
     for cmd in parser_cmds:

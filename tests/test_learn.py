@@ -1,0 +1,434 @@
+"""Continuous-learning plane: replay-buffer invariants (bounded eviction,
+reservoir fairness, crash adoption, deterministic reads, disposition join),
+supervisor launch discipline, doctor/CLI surfaces (docs/learning.md)."""
+
+import json
+import math
+import threading
+import time
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from nerrf_tpu import cli
+from nerrf_tpu.archive import list_segments
+from nerrf_tpu.data.synth import SimConfig, simulate_trace
+from nerrf_tpu.flight.doctor import learn_section
+from nerrf_tpu.flight.journal import KNOWN_KINDS, EventJournal
+from nerrf_tpu.learn import (
+    ReplayConfig,
+    ReplayWriter,
+    RetrainConfig,
+    RetrainSupervisor,
+    append_disposition,
+    build_replay_dataset,
+    iter_replay,
+    load_dispositions,
+    replay_batches,
+    replay_fingerprint,
+    replay_stats,
+)
+from nerrf_tpu.observability import MetricsRegistry
+from nerrf_tpu.train.data import DatasetConfig, window_sample
+
+WINDOW_NS = 15_000_000_000
+STRIDE_NS = 5_000_000_000
+
+
+def make_trace(seed=3, duration=60.0):
+    return simulate_trace(SimConfig(
+        duration_sec=duration, attack=True, attack_start_sec=duration / 3,
+        num_target_files=4, benign_rate_hz=6.0, seed=seed))
+
+
+def trace_windows(trace, n):
+    """First ``n`` (idx, lo, hi) windows of a trace, serve geometry."""
+    ts = trace.events.ts_ns[trace.events.valid]
+    t0 = int(ts.min())
+    return [(i, t0 + i * STRIDE_NS, t0 + i * STRIDE_NS + WINDOW_NS)
+            for i in range(n)]
+
+
+def scored_for(trace_id, stream="s0-0", version=1):
+    """The slice of serve's Scored result the replay tee reads."""
+    return SimpleNamespace(
+        trace_id=trace_id, stream=stream, bucket=(256, 512, 128),
+        model_version=version, node_mask=np.ones(4, dtype=bool),
+        probs=np.array([0.1, 0.9, 0.2, 0.05], dtype=np.float32),
+        nodes=4, edges=6, files=2)
+
+
+def make_writer(tmp_path, **over):
+    over.setdefault("out_dir", str(tmp_path / "replay"))
+    reg = MetricsRegistry(namespace="t")
+    return ReplayWriter(ReplayConfig(**over), registry=reg), reg
+
+
+def feed(writer, trace, stream, windows, prefix="t"):
+    """Admit + score each window; returns the trace_ids used."""
+    tids = []
+    for idx, lo, hi in windows:
+        tid = f"{prefix}-{stream}-{idx}"
+        writer.observe_admit(tid, stream, idx, lo, hi,
+                            trace.events, trace.strings)
+        writer.observe_scored(scored_for(tid, stream=f"{stream}-0"))
+        tids.append(tid)
+    return tids
+
+
+# -- replay buffer ------------------------------------------------------------
+
+
+class TestReplayBuffer:
+    def test_roundtrip_bit_exact_through_window_sample(self, tmp_path):
+        """A replayed record lowers to the IDENTICAL sample the trainer
+        would build from the original trace — serialization fidelity is
+        the whole point of the buffer."""
+        trace = make_trace()
+        (idx, lo, hi), = trace_windows(trace, 1)
+        w, _ = make_writer(tmp_path)
+        feed(w, trace, "s0", [(idx, lo, hi)])
+        w.flush()
+        w.close()
+        ds_cfg = DatasetConfig()
+        ds, info = build_replay_dataset(tmp_path / "replay", ds_cfg)
+        assert ds is not None and info["windows"] == 1
+        labels = np.zeros(len(trace.events.ts_ns), dtype=np.float32)
+        expect, _ = window_sample(trace, lo, hi, ds_cfg, labels=labels)
+        assert expect is not None
+        assert set(ds.arrays.keys()) == set(expect.keys())
+        for k, v in ds.arrays.items():
+            assert np.array_equal(v[0], expect[k]), k
+
+    def test_bounded_eviction_oldest_first(self, tmp_path):
+        """Retention prunes whole sealed segments oldest-first: the
+        surviving records are a contiguous SUFFIX of what was fed, and
+        on-disk bytes stay near the bound."""
+        trace = make_trace()
+        windows = trace_windows(trace, 40)
+        w, _ = make_writer(tmp_path, segment_max_bytes=4096,
+                           max_total_bytes=16384, max_events=8,
+                           per_stream_quota=10 ** 6)
+        feed(w, trace, "s0", windows)
+        w.flush()
+        w.close()
+        recs = list(iter_replay(tmp_path / "replay"))
+        idxs = [r["window_idx"] for r in recs]
+        assert 0 < len(idxs) < 40, "retention must have pruned something"
+        assert idxs == list(range(min(idxs), 40)), \
+            "survivors must be the newest contiguous suffix"
+        assert min(idxs) > 0
+        disk = sum(p.stat().st_size
+                   for p in (tmp_path / "replay").iterdir() if p.is_file())
+        assert disk <= 16384 + 4096  # bound + one in-flight segment
+
+    def test_reservoir_fairness_hot_stream(self, tmp_path):
+        """Algorithm-R per stream: a 100:1 hot stream lands ~log-ratio in
+        the buffer, and the quiet stream keeps everything."""
+        trace = make_trace()
+        (idx, lo, hi), = trace_windows(trace, 1)
+        quota = 16
+        w, _ = make_writer(tmp_path, per_stream_quota=quota, max_events=4,
+                           pending_slots=4)
+        for i in range(100 * quota):
+            w.observe_admit(f"h-{i}", "hot", i, lo, hi,
+                            trace.events, trace.strings)
+        for i in range(quota):
+            w.observe_admit(f"c-{i}", "cold", i, lo, hi,
+                            trace.events, trace.strings)
+        acc = w.stats()["accepted"]
+        w.close()
+        assert acc["cold"] == quota  # n <= quota: everything kept
+        # E[hot] = quota * (1 + ln(100)) ~= 90; allow generous slack but
+        # stay an order of magnitude below the 100:1 offered ratio
+        expected = quota * (1 + math.log(100))
+        assert quota <= acc["hot"] <= 2.2 * expected
+        assert acc["hot"] / acc["cold"] < 13
+
+    def test_reservoir_deterministic_per_seed(self, tmp_path):
+        trace = make_trace()
+        (idx, lo, hi), = trace_windows(trace, 1)
+        counts = []
+        for d in ("a", "b"):
+            w, _ = make_writer(tmp_path, out_dir=str(tmp_path / d),
+                               per_stream_quota=8, max_events=4, seed=7)
+            for i in range(400):
+                w.observe_admit(f"x-{i}", "s0", i, lo, hi,
+                                trace.events, trace.strings)
+            counts.append(w.stats()["accepted"]["s0"])
+            w.close()
+        assert counts[0] == counts[1]
+
+    def test_crash_mid_write_adoption(self, tmp_path):
+        """kill -9 shape: abandoned ``.open`` tail with a torn last line.
+        The next writer adopts it; readers keep every intact record."""
+        trace = make_trace()
+        windows = trace_windows(trace, 4)
+        rdir = tmp_path / "replay"
+        w, _ = make_writer(tmp_path)
+        tids = feed(w, trace, "s0", windows[:3])
+        w.flush()
+        # simulate the crash: stop the writer thread WITHOUT sealing
+        w._stop.set()
+        w._thread.join(timeout=10)
+        opens = [p for p in rdir.iterdir() if p.name.endswith(".jsonl.open")]
+        assert opens, "crash must leave an .open tail behind"
+        with open(opens[0], "ab") as f:
+            f.write(b'{"v":"1.0","kind":"replay_window","torn')  # no newline
+        w2, _ = make_writer(tmp_path)
+        tids += feed(w2, trace, "s0", windows[3:], prefix="t2")
+        w2.flush()
+        w2.close()
+        recs = list(iter_replay(rdir))
+        assert sorted(r["trace_id"] for r in recs) == sorted(tids)
+        assert not any(s.endswith(".open") for s in list_segments(rdir))
+
+    def test_deterministic_seeded_batches(self, tmp_path):
+        trace = make_trace()
+        windows = trace_windows(trace, 6)
+        w, _ = make_writer(tmp_path)
+        feed(w, trace, "s0", windows)
+        w.flush()
+        w.close()
+        ds_cfg = DatasetConfig()
+        runs = []
+        for _ in range(2):
+            ds, info = build_replay_dataset(tmp_path / "replay", ds_cfg,
+                                            seed=3)
+            runs.append(list(replay_batches(ds, batch_size=2, seed=5)))
+        assert len(runs[0]) == 3
+        for b1, b2 in zip(runs[0], runs[1]):
+            for k in b1:
+                assert np.array_equal(b1[k], b2[k]), k
+        # a different seed yields a different order
+        ds, _ = build_replay_dataset(tmp_path / "replay", ds_cfg, seed=3)
+        other = list(replay_batches(ds, batch_size=2, seed=6))
+        assert any(not np.array_equal(runs[0][i]["node_feat"],
+                                      other[i]["node_feat"])
+                   for i in range(len(other)))
+
+    def test_disposition_join_last_wins(self, tmp_path):
+        trace = make_trace()
+        windows = trace_windows(trace, 3)
+        w, _ = make_writer(tmp_path)
+        tids = feed(w, trace, "s0", windows)
+        w.flush()
+        w.close()
+        rdir = tmp_path / "replay"
+        append_disposition(rdir, tids[0], "fp")
+        append_disposition(rdir, tids[0], "tp", note="analyst confirmed")
+        append_disposition(rdir, "no-such-window", "tp")
+        with pytest.raises(ValueError):
+            append_disposition(rdir, tids[1], "maybe")
+        dispo = load_dispositions(rdir)
+        assert dispo[tids[0]]["label"] == "tp"  # last-wins
+        ds, info = build_replay_dataset(rdir, DatasetConfig())
+        assert info["labeled_tp"] == 1
+        stats = replay_stats(rdir)
+        assert stats["windows"] == 3 and stats["dispositions"] == 2
+        assert stats["fingerprint"] == replay_fingerprint(rdir)
+
+    def test_failed_window_never_becomes_training_data(self, tmp_path):
+        trace = make_trace()
+        (idx, lo, hi), = trace_windows(trace, 1)
+        w, _ = make_writer(tmp_path)
+        w.observe_admit("dead", "s0", idx, lo, hi,
+                        trace.events, trace.strings)
+        w.discard("dead")  # the device failed it
+        w.observe_scored(scored_for("dead"))
+        w.flush()
+        w.close()
+        assert list(iter_replay(tmp_path / "replay")) == []
+
+    def test_metrics_surface(self, tmp_path):
+        trace = make_trace()
+        windows = trace_windows(trace, 2)
+        w, reg = make_writer(tmp_path)
+        feed(w, trace, "s0", windows)
+        w.flush()
+        time.sleep(0.1)
+        assert reg.value("learn_replay_windows_total",
+                         labels={"stream": "s0"}) == 2.0
+        assert reg.value("learn_replay_bytes") > 0
+        w.close()
+
+
+# -- retrain supervisor (injectable retrain_fn — no jax) ----------------------
+
+
+def make_supervisor(journal, reg, retrain_fn, **over):
+    over.setdefault("cooldown_sec", 0.25)
+    over.setdefault("debounce_window_sec", 60.0)
+    return RetrainSupervisor(
+        store=None, model_cfg=None, cfg=RetrainConfig(**over),
+        registry=reg, journal=journal, retrain_fn=retrain_fn)
+
+
+class TestRetrainSupervisor:
+    def test_debounce_cooldown_single_flight(self, tmp_path):
+        reg = MetricsRegistry(namespace="t")
+        journal = EventJournal(registry=reg)
+        gate = threading.Event()
+        runs = []
+
+        def retrain_fn(seq):
+            runs.append(seq)
+            gate.wait(10)
+            return "published"
+
+        sup = make_supervisor(journal, reg, retrain_fn, debounce_triggers=2)
+        try:
+            journal.record("bundle", trigger="quality_drift", path="a")
+            assert sup.launches == 0  # debounce: one trigger is not sustained
+            journal.record("bundle", trigger="p99_latency", path="b")
+            journal.record("admission_drop", reason="x")
+            assert sup.launches == 0  # wrong trigger/kind never arms
+            journal.record("bundle", trigger="quality_drift", path="c")
+            assert sup.launches == 1 and sup.active
+            assert reg.value("retrain_active") == 1.0
+            for _ in range(3):  # breaches during an active retrain
+                journal.record("bundle", trigger="quality_drift", path="d")
+            assert sup.launches == 1, "single-flight must hold"
+            gate.set()
+            assert sup.wait(10)
+            assert not sup.active and sup.last_outcome == "published"
+            assert reg.value("retrain_active") == 0.0
+            # cooldown runs from the FINISH of the last run
+            journal.record("bundle", trigger="quality_drift", path="e")
+            journal.record("bundle", trigger="quality_drift", path="f")
+            assert sup.launches == 1
+            time.sleep(0.35)
+            journal.record("bundle", trigger="quality_drift", path="g")
+            journal.record("bundle", trigger="quality_drift", path="h")
+            assert sup.launches == 2
+            assert sup.wait(10)
+            assert reg.value("retrain_runs_total",
+                             labels={"outcome": "published"}) == 2.0
+        finally:
+            gate.set()
+            sup.close(timeout=10)
+
+    def test_error_journals_abort_and_counts(self, tmp_path):
+        reg = MetricsRegistry(namespace="t")
+        journal = EventJournal(registry=reg)
+
+        def retrain_fn(seq):
+            raise RuntimeError("boom")
+
+        sup = make_supervisor(journal, reg, retrain_fn)
+        try:
+            journal.record("bundle", trigger="quality_drift", path="a")
+            assert sup.wait(10)
+            assert sup.last_outcome == "error"
+            aborted = journal.tail(kinds=("retrain_aborted",))
+            assert len(aborted) == 1
+            assert "RuntimeError" in aborted[0].data["reason"]
+            assert reg.value("retrain_runs_total",
+                             labels={"outcome": "error"}) == 1.0
+        finally:
+            sup.close(timeout=10)
+
+    def test_closed_supervisor_ignores_triggers(self, tmp_path):
+        reg = MetricsRegistry(namespace="t")
+        journal = EventJournal(registry=reg)
+        sup = make_supervisor(journal, reg, lambda seq: "published")
+        sup.close(timeout=10)
+        journal.record("bundle", trigger="quality_drift", path="a")
+        assert sup.launches == 0
+
+
+# -- doctor / journal / metrics-contract surfaces -----------------------------
+
+
+def test_journal_kinds_include_learn_plane():
+    assert {"alert_disposition", "retrain_triggered", "retrain_done",
+            "retrain_aborted"} <= set(KNOWN_KINDS)
+
+
+def test_metrics_contract_includes_learn_plane():
+    from nerrf_tpu.analysis.metrics_contract import REQUIRED
+
+    assert {"learn_replay_windows_total", "learn_replay_bytes",
+            "retrain_runs_total", "retrain_active"} <= set(REQUIRED)
+
+
+def test_doctor_learn_section_degrades_and_reports():
+    assert learn_section({"records": []}) == [
+        "learn: no continuous-learning records in bundle "
+        "(supervisor not attached, or the run predates it)"]
+    journal = EventJournal(registry=MetricsRegistry(namespace="t"))
+    journal.record("retrain_triggered", trigger_seq=7, parent_version=1,
+                   replay_fingerprint="abcd1234")
+    journal.record("retrain_aborted", trigger_seq=7,
+                   reason="non-finite loss at step 4")
+    journal.record("retrain_triggered", trigger_seq=9, parent_version=1,
+                   replay_fingerprint="abcd1234")
+    journal.record("retrain_done", trigger_seq=9, lineage="default",
+                   version=2, parent_version=1, replay_fingerprint="abcd1234",
+                   edge_auc=0.93, wall_sec=12.5, steps_per_sec=4.0)
+    journal.record("alert_disposition", trace_id="t-1", label="tp")
+    lines = learn_section({"records": journal.tail()})
+    text = "\n".join(lines)
+    assert "2 triggered" in text and "1 published" in text
+    assert "1 aborted" in text and "dispositions: 1" in text
+    assert "non-finite loss" in text
+    assert "v1 → v2" in text and "abcd1234" in text
+
+
+# -- CLI ----------------------------------------------------------------------
+
+
+class TestCli:
+    def test_alerts_label_roundtrip(self, tmp_path, capsys):
+        rc = cli.main(["alerts", "label", "tid-9", "tp",
+                       "--note", "confirmed exfil",
+                       "--replay-dir", str(tmp_path)])
+        assert not rc
+        out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert out["trace_id"] == "tid-9" and out["label"] == "tp"
+        dispo = load_dispositions(tmp_path)
+        assert dispo["tid-9"]["note"] == "confirmed exfil"
+        with pytest.raises(SystemExit):  # argparse rejects bad labels
+            cli.main(["alerts", "label", "tid-9", "maybe",
+                      "--replay-dir", str(tmp_path)])
+
+    def test_export_replay_reader(self, tmp_path, capsys):
+        trace = make_trace()
+        windows = trace_windows(trace, 2)
+        w, _ = make_writer(tmp_path)
+        feed(w, trace, "s0", windows)
+        w.flush()
+        w.close()
+        rc = cli.main(["archive", "export", str(tmp_path / "replay"),
+                       "--replay", "--seed", "1", "--batch-size", "2",
+                       "--out", str(tmp_path / "replay.npz")])
+        assert not rc
+        out = capsys.readouterr().out
+        doc = json.loads(out[out.index("{"):])  # indent=2 report doc
+        assert doc["stats"]["windows"] == 2 and doc["batches"] >= 1
+        assert (tmp_path / "replay.npz").exists()
+
+    def test_export_replay_refuses_empty_buffer(self, tmp_path, capsys):
+        (tmp_path / "empty").mkdir()
+        rc = cli.main(["archive", "export", str(tmp_path / "empty"),
+                       "--replay"])
+        assert rc == 1
+
+
+# -- artifact of record -------------------------------------------------------
+
+
+def test_checked_in_learn_artifact_meets_acceptance(repo_root):
+    """The closed-loop soak gate, judged over the checked-in CPU artifact
+    (regenerate with ``python benchmarks/run_learn_bench.py``)."""
+    import sys
+
+    sys.path.insert(0, str(repo_root / "benchmarks"))
+    try:
+        from run_learn_bench import gates
+    finally:
+        sys.path.pop(0)
+    art = json.loads((repo_root / "benchmarks" / "results"
+                      / "learn_bench_cpu.json").read_text())
+    assert [name for name, ok in gates(art) if not ok] == []
