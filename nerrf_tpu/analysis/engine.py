@@ -46,8 +46,10 @@ REPO = Path(__file__).resolve().parents[2]
 BASELINE_NAME = ".nerrflint-baseline"
 DEFAULT_PATHS = ("nerrf_tpu",)
 
-# schema version of the --json document (tests pin the key set)
-JSON_SCHEMA_VERSION = 1
+# schema version of the --json document (tests pin the key set).
+# 1 → "1.1": each `rules` entry gained `elapsed_sec` (per-rule wall time,
+# so the queue pre-flights can log which rule eats the budget).
+JSON_SCHEMA_VERSION = "1.1"
 
 _SUPPRESS = re.compile(r"#\s*nerrflint:\s*ok\[([a-z0-9-]+)\]\s*(\S.*)?")
 
@@ -109,13 +111,21 @@ def default_rules() -> List[Rule]:
     )
     from nerrf_tpu.analysis.locks import LockDiscipline
     from nerrf_tpu.analysis.metrics_contract import MetricsContract
+    from nerrf_tpu.analysis.operability import (
+        AtomicWrite,
+        BoundedGrowth,
+        FailurePolicy,
+        JournalContract,
+    )
     from nerrf_tpu.analysis.purity import JaxPurity
     from nerrf_tpu.analysis.recompile import RecompileHazard
     from nerrf_tpu.analysis.syncs import SyncInHotLoop
 
     return [JaxPurity(), RecompileHazard(), SyncInHotLoop(),
             LockDiscipline(), AtomicityViolation(), CallbackUnderLock(),
-            BlockingUnderLock(), ThreadLifecycle(), MetricsContract()]
+            BlockingUnderLock(), ThreadLifecycle(), MetricsContract(),
+            AtomicWrite(), JournalContract(), FailurePolicy(),
+            BoundedGrowth()]
 
 
 # -- baseline -----------------------------------------------------------------
@@ -186,6 +196,7 @@ class Report:
     files: int
     elapsed: float
     rules: List[Rule]
+    rule_elapsed: Dict[str, float] = dataclasses.field(default_factory=dict)
 
     @property
     def ok(self) -> bool:
@@ -197,7 +208,9 @@ class Report:
             "ok": self.ok,
             "files": self.files,
             "elapsed_sec": round(self.elapsed, 3),
-            "rules": [{"id": r.id, "description": r.description}
+            "rules": [{"id": r.id, "description": r.description,
+                       "elapsed_sec": round(
+                           self.rule_elapsed.get(r.id, 0.0), 4)}
                       for r in self.rules],
             "findings": [f.to_dict() for f in self.findings],
             "suppressed": [f.to_dict() for f in self.suppressed],
@@ -222,7 +235,9 @@ def analyze(root: Path = REPO, paths: Sequence[str] = DEFAULT_PATHS,
     errors = list(project.errors) + list(baseline.errors)
 
     raw: List[Finding] = []
+    rule_elapsed: Dict[str, float] = {}
     for rule in rules:
+        r0 = time.perf_counter()
         try:
             raw.extend(rule.run(project))
         except Exception as e:  # noqa: BLE001 — a crashed rule is exit 2,
@@ -231,6 +246,8 @@ def analyze(root: Path = REPO, paths: Sequence[str] = DEFAULT_PATHS,
             errors.append(
                 f"rule {rule.id or type(rule).__name__} crashed: "
                 f"{type(e).__name__}: {e}")
+        rule_elapsed[rule.id] = (rule_elapsed.get(rule.id, 0.0)
+                                 + time.perf_counter() - r0)
     raw.sort(key=lambda f: (f.path, f.line, f.rule))
 
     seen_keys = set()
@@ -250,7 +267,8 @@ def analyze(root: Path = REPO, paths: Sequence[str] = DEFAULT_PATHS,
             findings.append(f)
     stale = sorted(set(baseline.entries) - matched)
     return Report(findings, suppressed, stale, errors,
-                  len(project.modules), time.perf_counter() - t0, rules)
+                  len(project.modules), time.perf_counter() - t0, rules,
+                  rule_elapsed)
 
 
 def main(argv=None) -> int:

@@ -1,0 +1,792 @@
+"""nerrflint operability tier: the durability / journal / failure-policy /
+bounded-growth conventions the last six planes established by hand.
+
+PRs 14-19 (trainwatch, archive, tune, fleet, respond, learn) each
+re-implemented the same operability conventions by review checklist:
+tmp-then-``os.replace`` atomic publishes, ``KNOWN_KINDS``-registered
+journal records, fail-open hot-path seams with counted drops, bounded
+deques on long-lived state.  Review kept catching violations after the
+fact (the unbounded ``fired`` ledger, the profile wipe, the non-atomic
+tuned-ladder write).  This tier turns each convention into a Rule so the
+default shallow pass enforces them on every test run:
+
+  * :class:`AtomicWrite` — a write landing in a durable, cross-process-
+    read location (registry lineages, archive dirs, flight bundles,
+    checkpoint dirs, tuned-ladder/bench artifacts) must stage to a tmp
+    name and ``os.replace`` into place.  Evidence is name-based: a write
+    whose path expression (after one level of local-alias expansion)
+    carries tmp/staging tokens is staged and legal; one carrying
+    durable-artifact tokens with no staging evidence is a finding.
+    Unresolved paths are *unknown*, never findings.
+  * :class:`JournalContract` — string-literal flow into
+    ``journal.record(kind, ...)`` call sites and hand-built
+    ``{"v": ..., "kind": ...}`` schema records: every emitted kind must
+    be registered in ``flight/journal.py``'s ``KNOWN_KINDS``, every
+    registered kind must have a reachable emitter, and a ``.record(``
+    site whose kind cannot be resolved to literals at all is itself a
+    finding (an uncheckable contract is a broken contract).  Kinds
+    emitted only from ``except`` handlers count as reachable — the
+    fail-open drop records are exactly the ones grep misses.
+  * :class:`FailurePolicy` — *declared* scopes, not inference: the
+    fail-open table lists producer-facing seams that must catch broadly,
+    never re-raise, and count every drop; the fail-closed table lists
+    durability seams that must never swallow a broad exception without
+    re-raising or recording the failure.  The tables double as the
+    machine-readable convention registry (docs/static-analysis.md).
+  * :class:`BoundedGrowth` — ``append``/``add``/``setdefault`` on a
+    container attribute of a long-lived class (Service/Monitor/
+    Controller/Router/... by name) from a non-``__init__`` method, with
+    no bound in evidence: no ``deque(maxlen=)``, no shrink op
+    (``pop``/``del``/``discard``/... — including through local aliases
+    like ``dq = self._pending[b]``), no rebind, no prune-named method
+    touching the attribute.
+
+All four are static approximations; unresolved stays unknown (never
+"clean by proof", per astutil), and the conservative direction is *few
+false positives* — the escape hatch for a deliberate violation is the
+standard inline ``# nerrflint: ok[rule-id] why`` with a justification.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from nerrf_tpu.analysis.astutil import (
+    FunctionInfo,
+    ModuleInfo,
+    Project,
+    body_nodes,
+    dotted,
+)
+from nerrf_tpu.analysis.engine import Finding, Rule
+
+
+def _tokens(node: ast.AST) -> Set[str]:
+    """Every Name id, Attribute attr and string constant under ``node`` —
+    the name-evidence soup the atomic-write rule classifies."""
+    out: Set[str] = set()
+    for n in ast.walk(node):
+        if isinstance(n, ast.Name):
+            out.add(n.id)
+        elif isinstance(n, ast.Attribute):
+            out.add(n.attr)
+        elif isinstance(n, ast.Constant) and isinstance(n.value, str):
+            out.add(n.value)
+    return out
+
+
+# -- atomic-write -------------------------------------------------------------
+
+# staging evidence: the write goes to a scratch name some later
+# os.replace/rename publishes — the repo-wide durable-publish idiom
+_TMP_RE = re.compile(r"(^|[._\-/])(tmp|temp|stage|staging|scratch|partial)",
+                     re.I)
+# durable-destination evidence: the cross-process-read artifact families
+# (registry lineages, archive dirs, flight bundles, checkpoint dirs,
+# tuned-ladder/bench artifacts).  `meta(?!ric)` keeps metrics.prom out.
+_DURABLE_RE = re.compile(
+    r"manifest|artifact|checkpoint|ckpt|lineage|ladder|bundle"
+    r"|meta(?!ric)|heartbeat", re.I)
+# a saving-shaped function pulls its module path into the evidence set,
+# which is how `save_artifact(path, ...)` in tune/artifact.py is caught
+# even though its path expression is an opaque parameter
+_SAVE_FN_RE = re.compile(r"save|publish|persist|seal|commit|export", re.I)
+
+_WRITE_METHODS = ("write_text", "write_bytes")
+
+
+class AtomicWrite(Rule):
+    id = "atomic-write"
+    description = ("durable-destination writes must stage to a tmp name "
+                   "and os.replace into place")
+
+    def run(self, project: Project) -> List[Finding]:
+        out: List[Finding] = []
+        for mod in project.modules.values():
+            for fi in mod.functions:
+                if not isinstance(fi.node, (ast.FunctionDef,
+                                            ast.AsyncFunctionDef)):
+                    continue
+                out.extend(self._check_fn(mod, fi))
+        return out
+
+    @staticmethod
+    def _aliases(fi: FunctionInfo) -> Dict[str, Set[str]]:
+        """local name -> token soup of everything ever assigned to it
+        (one level: `sidecar = tmp / "x.json"` makes sidecar tmp-ish)."""
+        table: Dict[str, Set[str]] = {}
+        for node in body_nodes(fi.node):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                table.setdefault(node.targets[0].id, set()).update(
+                    _tokens(node.value))
+        # second pass closes simple alias chains (a = tmp; b = a / "x")
+        for name, toks in table.items():
+            extra: Set[str] = set()
+            for t in toks:
+                extra.update(table.get(t, ()))
+            toks.update(extra)
+        return table
+
+    def _check_fn(self, mod: ModuleInfo, fi: FunctionInfo) -> List[Finding]:
+        findings: List[Finding] = []
+        aliases = self._aliases(fi)
+        ctx: Set[str] = set()
+        if _SAVE_FN_RE.search(fi.node.name):
+            ctx.update(re.split(r"[/._\-]", mod.path))
+            ctx.add(fi.node.name)
+        for call in (n for n in body_nodes(fi.node)
+                     if isinstance(n, ast.Call)):
+            path_expr = self._write_target(call)
+            if path_expr is None:
+                continue
+            toks = _tokens(path_expr)
+            for t in list(toks):
+                toks.update(aliases.get(t, ()))
+            if any(_TMP_RE.search(t) for t in toks):
+                continue  # staged write: some later replace publishes it
+            if not any(_DURABLE_RE.search(t) for t in toks | ctx):
+                continue  # unknown destination: not provably durable
+            names = [n.id for n in ast.walk(path_expr)
+                     if isinstance(n, ast.Name)]
+            strs = [n.value for n in ast.walk(path_expr)
+                    if isinstance(n, ast.Constant)
+                    and isinstance(n.value, str)]
+            leaf = (strs[-1] if strs else
+                    (names[-1] if names else "path"))
+            findings.append(Finding(
+                rule=self.id, path=mod.path, line=call.lineno,
+                message=(f"{fi.qualname} writes durable destination "
+                         f"{leaf!r} in place — a crash mid-write leaves a "
+                         f"torn artifact for cross-process readers"),
+                hint=("write to a tmp name in the same directory, then "
+                      "os.replace() it onto the final name"),
+                anchor=f"{fi.qualname}:{leaf}"))
+        return findings
+
+    @staticmethod
+    def _write_target(call: ast.Call) -> Optional[ast.AST]:
+        """The path expression of a direct-write call, else None.
+        Covers ``X.write_text/write_bytes(...)`` and builtin
+        ``open(path, "w"/"x"...)``; append modes and reads are not
+        in-place publishes and stay out of scope."""
+        if isinstance(call.func, ast.Attribute) \
+                and call.func.attr in _WRITE_METHODS:
+            return call.func.value
+        if isinstance(call.func, ast.Name) and call.func.id == "open":
+            mode = None
+            if len(call.args) >= 2:
+                mode = call.args[1]
+            for kw in call.keywords:
+                if kw.arg == "mode":
+                    mode = kw.value
+            if isinstance(mode, ast.Constant) and isinstance(mode.value, str) \
+                    and ("w" in mode.value or "x" in mode.value):
+                return call.args[0] if call.args else None
+        return None
+
+
+# -- journal-contract ---------------------------------------------------------
+
+_JOURNALISH_RE = re.compile(r"journal|jrn", re.I)
+
+
+class JournalContract(Rule):
+    id = "journal-contract"
+    description = ("every emitted journal/record kind is registered in "
+                   "KNOWN_KINDS and every registered kind has a reachable "
+                   "emitter")
+
+    def __init__(self, journal_module: str = "nerrf_tpu.flight.journal"
+                 ) -> None:
+        self.journal_module = journal_module
+
+    def run(self, project: Project) -> List[Finding]:
+        jmod = project.modules.get(self.journal_module)
+        if jmod is None:
+            return []
+        known, known_line = self._known_kinds(jmod)
+        if known is None:
+            return [Finding(
+                rule=self.id, path=jmod.path, line=1,
+                message=(f"{self.journal_module} defines no KNOWN_KINDS "
+                         f"tuple of string literals — the journal kind "
+                         f"contract is unenforceable"),
+                hint="declare KNOWN_KINDS = (\"kind\", ...) at module level",
+                anchor="missing:KNOWN_KINDS")]
+
+        self._consts = {name: self._module_consts(m)
+                        for name, m in project.modules.items()}
+        findings: List[Finding] = []
+        emitted: Set[str] = set()
+        for mod in project.modules.values():
+            for fi, call in self._calls(mod):
+                if not self._journalish_record(call):
+                    continue
+                kind_expr = call.args[0] if call.args else next(
+                    (kw.value for kw in call.keywords if kw.arg == "kind"),
+                    None)
+                qual = fi.qualname if fi else "<module>"
+                if kind_expr is None:
+                    continue
+                kinds = self._literals(project, mod, fi, kind_expr)
+                if not kinds:
+                    findings.append(Finding(
+                        rule=self.id, path=mod.path, line=call.lineno,
+                        message=(f"{qual} records a journal kind that "
+                                 f"resolves to no string literal — the "
+                                 f"KNOWN_KINDS contract cannot be checked "
+                                 f"here"),
+                        hint=("emit a literal kind (or flow one through "
+                              "local/module constants or call-site "
+                              "arguments)"),
+                        anchor=f"unresolved:{qual}"))
+                    continue
+                emitted.update(kinds)
+                findings.extend(self._check_registered(
+                    kinds, known, mod, call.lineno, qual))
+            # hand-built schema records: {"v": ..., "kind": ...} dicts
+            # (the archive writer / replay buffer build these directly)
+            for fi, d in self._record_dicts(mod):
+                kind_expr = self._dict_value(d, "kind")
+                kinds = self._literals(project, mod, fi, kind_expr)
+                if not kinds:
+                    continue  # serializer side (kind=self.kind): reader,
+                    # not emitter — only .record( sites must resolve
+                emitted.update(kinds)
+                findings.extend(self._check_registered(
+                    kinds, known, mod, d.lineno,
+                    fi.qualname if fi else "<module>"))
+        for k in sorted(known - emitted):
+            findings.append(Finding(
+                rule=self.id, path=jmod.path, line=known_line,
+                message=(f"KNOWN_KINDS registers {k!r} but no reachable "
+                         f"emitter records it — dead contract entry"),
+                hint=("delete the kind or fix the emitter gap "
+                      "(emitters inside except handlers count)"),
+                anchor=f"unreached:{k}"))
+        return findings
+
+    def _check_registered(self, kinds: Set[str], known: Set[str],
+                          mod: ModuleInfo, line: int, qual: str
+                          ) -> List[Finding]:
+        return [Finding(
+            rule=self.id, path=mod.path, line=line,
+            message=(f"{qual} emits kind {k!r} which is not registered "
+                     f"in KNOWN_KINDS"),
+            hint="add it to flight/journal.py KNOWN_KINDS",
+            anchor=f"kind:{k}") for k in sorted(kinds - known)]
+
+    # -- harvesting ----------------------------------------------------------
+
+    @staticmethod
+    def _known_kinds(jmod: ModuleInfo
+                     ) -> Tuple[Optional[Set[str]], int]:
+        for node in jmod.tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and node.targets[0].id == "KNOWN_KINDS" \
+                    and isinstance(node.value, (ast.Tuple, ast.List)):
+                vals = [e.value for e in node.value.elts
+                        if isinstance(e, ast.Constant)
+                        and isinstance(e.value, str)]
+                if vals:
+                    return set(vals), node.lineno
+        return None, 0
+
+    @staticmethod
+    def _module_consts(mod: ModuleInfo) -> Dict[str, str]:
+        out: Dict[str, str] = {}
+        for node in mod.tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and isinstance(node.value, ast.Constant) \
+                    and isinstance(node.value.value, str):
+                out[node.targets[0].id] = node.value.value
+        return out
+
+    @staticmethod
+    def _calls(mod: ModuleInfo):
+        """(enclosing FunctionInfo | None, Call) for every call in the
+        module — function bodies via the index, plus module level."""
+        for fi in mod.functions:
+            for n in body_nodes(fi.node):
+                if isinstance(n, ast.Call):
+                    yield fi, n
+        stack: List[ast.AST] = list(mod.tree.body)
+        while stack:
+            n = stack.pop()
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+                continue
+            if isinstance(n, ast.Call):
+                yield None, n
+            stack.extend(ast.iter_child_nodes(n))
+
+    @staticmethod
+    def _journalish_record(call: ast.Call) -> bool:
+        if not (isinstance(call.func, ast.Attribute)
+                and call.func.attr == "record"):
+            return False
+        recv = _tokens(call.func.value)
+        return any(_JOURNALISH_RE.search(t) for t in recv)
+
+    def _record_dicts(self, mod: ModuleInfo):
+        def keyset(d: ast.Dict) -> Set[str]:
+            return {k.value for k in d.keys
+                    if isinstance(k, ast.Constant)
+                    and isinstance(k.value, str)}
+        for fi in mod.functions:
+            for n in body_nodes(fi.node):
+                if isinstance(n, ast.Dict) and {"v", "kind"} <= keyset(n):
+                    yield fi, n
+
+    @staticmethod
+    def _dict_value(d: ast.Dict, key: str) -> Optional[ast.AST]:
+        for k, v in zip(d.keys, d.values):
+            if isinstance(k, ast.Constant) and k.value == key:
+                return v
+        return None
+
+    # -- literal flow --------------------------------------------------------
+
+    def _literals(self, project: Project, mod: ModuleInfo,
+                  fi: Optional[FunctionInfo], expr: Optional[ast.AST],
+                  depth: int = 0) -> Set[str]:
+        """The set of string literals ``expr`` can take: constants,
+        both arms of a conditional, local assignments (including
+        tuple-unpack from tuple-literal sources — the batcher's
+        ``kind, data = flipped`` watchdog flow), module constants,
+        imported constants, and — for a parameter — the literals its
+        resolvable call sites pass (one level deep)."""
+        if expr is None or depth > 3:
+            return set()
+        if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+            return {expr.value}
+        if isinstance(expr, ast.IfExp):
+            return (self._literals(project, mod, fi, expr.body, depth)
+                    | self._literals(project, mod, fi, expr.orelse, depth))
+        if not isinstance(expr, ast.Name):
+            return set()
+        name = expr.id
+        out: Set[str] = set()
+        if fi is not None:
+            for node in body_nodes(fi.node):
+                if not isinstance(node, ast.Assign) \
+                        or len(node.targets) != 1:
+                    continue
+                tgt = node.targets[0]
+                if isinstance(tgt, ast.Name) and tgt.id == name:
+                    out |= self._literals(project, mod, fi, node.value,
+                                          depth + 1)
+                elif isinstance(tgt, ast.Tuple):
+                    for i, el in enumerate(tgt.elts):
+                        if isinstance(el, ast.Name) and el.id == name:
+                            out |= self._tuple_elem(
+                                project, mod, fi, node.value, i, depth)
+            if out:
+                return out
+        consts = self._consts.get(mod.name, {})
+        if name in consts:
+            return {consts[name]}
+        full = mod.imports.get(name)
+        if full and "." in full:
+            src, _, attr = full.rpartition(".")
+            src_consts = self._consts.get(src, {})
+            if attr in src_consts:
+                return {src_consts[attr]}
+        if fi is not None and name in fi.params and depth == 0:
+            return self._from_call_sites(project, fi, name)
+        return set()
+
+    def _tuple_elem(self, project: Project, mod: ModuleInfo,
+                    fi: FunctionInfo, value: ast.AST, idx: int,
+                    depth: int) -> Set[str]:
+        """Element ``idx`` of a tuple-unpack RHS: a tuple literal
+        directly, or a Name whose assignments are tuple literals."""
+        if isinstance(value, ast.Tuple) and idx < len(value.elts):
+            return self._literals(project, mod, fi, value.elts[idx],
+                                  depth + 1)
+        out: Set[str] = set()
+        if isinstance(value, ast.Name):
+            for node in body_nodes(fi.node):
+                if isinstance(node, ast.Assign) \
+                        and len(node.targets) == 1 \
+                        and isinstance(node.targets[0], ast.Name) \
+                        and node.targets[0].id == value.id \
+                        and isinstance(node.value, ast.Tuple) \
+                        and idx < len(node.value.elts):
+                    out |= self._literals(project, mod, fi,
+                                          node.value.elts[idx], depth + 1)
+        return out
+
+    def _from_call_sites(self, project: Project, target: FunctionInfo,
+                         param: str) -> Set[str]:
+        """Literals flowing into ``param`` from every call site the
+        project can resolve to ``target`` (how the archive writer's
+        ``_emit(kind, ...)`` helper resolves to its literal kinds)."""
+        try:
+            pos = target.params.index(param)
+        except ValueError:
+            return set()
+        if target.cls is not None and target.params \
+                and target.params[0] == "self":
+            pos -= 1  # bound call: self is not an argument
+        out: Set[str] = set()
+        for mod in project.modules.values():
+            for fi, call in self._calls(mod):
+                if target not in project.resolve_call(mod, fi, call):
+                    continue
+                arg: Optional[ast.AST] = None
+                if 0 <= pos < len(call.args):
+                    arg = call.args[pos]
+                for kw in call.keywords:
+                    if kw.arg == param:
+                        arg = kw.value
+                if arg is not None:
+                    out |= self._literals(project, mod, fi, arg, depth=1)
+        return out
+
+
+# -- failure-policy -----------------------------------------------------------
+
+_BROAD_EXC = {"Exception", "BaseException"}
+_DROP_RE = re.compile(r"drop|record|inc|count|fail|err", re.I)
+_RECORDED_RE = re.compile(r"record|fail|refus|err|detail|skip", re.I)
+
+
+def _handler_names(h: ast.ExceptHandler) -> Set[str]:
+    """The exception class names a handler catches ('' for bare)."""
+    if h.type is None:
+        return {""}
+    out: Set[str] = set()
+    for n in ([h.type] if not isinstance(h.type, ast.Tuple)
+              else h.type.elts):
+        d = dotted(n)
+        if d is not None:
+            out.add(d.rpartition(".")[2])
+    return out
+
+
+def _handler_tokens(h: ast.ExceptHandler) -> Set[str]:
+    out: Set[str] = set()
+    for stmt in h.body:
+        out |= _tokens(stmt)
+    return out
+
+
+def _handler_raises(h: ast.ExceptHandler) -> bool:
+    return any(isinstance(n, ast.Raise)
+               for stmt in h.body for n in ast.walk(stmt))
+
+
+class FailurePolicy(Rule):
+    id = "failure-policy"
+    description = ("declared fail-open scopes catch broadly and count "
+                   "every drop; declared fail-closed scopes never swallow "
+                   "broad exceptions")
+
+    # The declared-scope registry (documented in docs/static-analysis.md):
+    # fail-open — producer-facing seams where an exception must cost at
+    # most the one observation, counted; fail-closed — durability seams
+    # where swallowing a broad failure forfeits the artifact silently.
+    FAIL_OPEN: Dict[str, Sequence[str]] = {
+        "nerrf_tpu.archive.spool": ("ArchiveSpool.append",),
+        "nerrf_tpu.serve.service": ("OnlineDetectionService._on_scored",),
+    }
+    FAIL_CLOSED: Dict[str, Sequence[str]] = {
+        "nerrf_tpu.registry.store": ("ModelRegistry.publish",),
+        "nerrf_tpu.rollback.executor": ("RollbackExecutor.execute",),
+    }
+
+    def __init__(self,
+                 fail_open: Optional[Dict[str, Sequence[str]]] = None,
+                 fail_closed: Optional[Dict[str, Sequence[str]]] = None
+                 ) -> None:
+        self.fail_open = self.FAIL_OPEN if fail_open is None else fail_open
+        self.fail_closed = (self.FAIL_CLOSED if fail_closed is None
+                            else fail_closed)
+
+    def run(self, project: Project) -> List[Finding]:
+        out: List[Finding] = []
+        for table, check in ((self.fail_open, self._check_open),
+                             (self.fail_closed, self._check_closed)):
+            for module, quals in table.items():
+                mod = project.modules.get(module)
+                if mod is None:
+                    continue  # scope outside the scanned set (fixtures)
+                for qual in quals:
+                    fi = self._lookup(mod, qual)
+                    if fi is None:
+                        out.append(Finding(
+                            rule=self.id, path=mod.path, line=1,
+                            message=(f"declared failure-policy scope "
+                                     f"{qual} not found in {module} — "
+                                     f"stale declaration"),
+                            hint=("update the FailurePolicy scope tables "
+                                  "in analysis/operability.py"),
+                            anchor=f"{qual}:missing"))
+                    else:
+                        out.extend(check(mod, fi))
+        return out
+
+    @staticmethod
+    def _lookup(mod: ModuleInfo, qual: str) -> Optional[FunctionInfo]:
+        cls, _, meth = qual.rpartition(".")
+        if cls:
+            return mod.methods.get((cls, meth))
+        return next((f for f in mod.by_name.get(qual, ())
+                     if "." not in f.qualname), None)
+
+    def _check_open(self, mod: ModuleInfo, fi: FunctionInfo
+                    ) -> List[Finding]:
+        out: List[Finding] = []
+        broad: List[ast.ExceptHandler] = []
+        for node in body_nodes(fi.node):
+            if isinstance(node, ast.Try):
+                for h in node.handlers:
+                    if _handler_names(h) & (_BROAD_EXC | {""}):
+                        broad.append(h)
+        if not broad:
+            out.append(Finding(
+                rule=self.id, path=mod.path, line=fi.line,
+                message=(f"declared fail-open scope {fi.qualname} has no "
+                         f"broad exception barrier — a raising observer "
+                         f"escapes into the producer"),
+                hint="wrap the observer calls in try/except Exception",
+                anchor=f"{fi.qualname}:no-barrier"))
+        for h in broad:
+            if _handler_raises(h):
+                out.append(Finding(
+                    rule=self.id, path=mod.path, line=h.lineno,
+                    message=(f"fail-open scope {fi.qualname} re-raises "
+                             f"from its broad handler — the producer pays "
+                             f"for an observer failure"),
+                    hint="count the drop and return instead of raising",
+                    anchor=f"{fi.qualname}:reraise"))
+            elif not any(_DROP_RE.search(t) for t in _handler_tokens(h)):
+                out.append(Finding(
+                    rule=self.id, path=mod.path, line=h.lineno,
+                    message=(f"fail-open scope {fi.qualname} swallows "
+                             f"without counting the drop — silent data "
+                             f"loss is invisible to the doctor planes"),
+                    hint=("count it (self._drop(...), counter_inc, or a "
+                          "journal record) inside the handler"),
+                    anchor=f"{fi.qualname}:uncounted"))
+        return out
+
+    def _check_closed(self, mod: ModuleInfo, fi: FunctionInfo
+                      ) -> List[Finding]:
+        out: List[Finding] = []
+        for node in body_nodes(fi.node):
+            if not isinstance(node, ast.Try):
+                continue
+            for h in node.handlers:
+                names = _handler_names(h)
+                # broad classes and OSError are the durability failures;
+                # a narrow enumerated catch (ValueError, ...) is a
+                # deliberate, bounded swallow and stays legal
+                if not (names & (_BROAD_EXC | {"", "OSError", "IOError"})):
+                    continue
+                if _handler_raises(h):
+                    continue
+                if any(_RECORDED_RE.search(t)
+                       for t in _handler_tokens(h)):
+                    continue
+                out.append(Finding(
+                    rule=self.id, path=mod.path, line=h.lineno,
+                    message=(f"fail-closed scope {fi.qualname} swallows "
+                             f"{'/'.join(sorted(names)) or 'all'} without "
+                             f"re-raising or recording the failure"),
+                    hint=("re-raise, or record the failure (journal / "
+                          "failure counter) before continuing"),
+                    anchor=f"{fi.qualname}:swallow"))
+        return out
+
+
+# -- bounded-growth -----------------------------------------------------------
+
+_LONGLIVED_RE = re.compile(
+    r"Service|Monitor|Controller|Router|Supervisor|Registry|Journal"
+    r"|Recorder|Batcher|Spool|Writer|Manager|Tracker|Queue|Cache"
+    r"|Observer|Client|Scheduler|Sink|Buffer")
+_CONTAINER_CTORS = {"list", "dict", "set", "defaultdict", "OrderedDict",
+                    "Counter"}
+_GROWTH_OPS = {"append", "appendleft", "extend", "add", "setdefault",
+               "insert"}
+_SHRINK_OPS = {"pop", "popleft", "popitem", "clear", "remove", "discard"}
+_PRUNE_METHOD_RE = re.compile(r"prune|evict|retire|trim|cleanup|expire"
+                              r"|remove", re.I)
+
+
+class BoundedGrowth(Rule):
+    id = "bounded-growth"
+    description = ("container attributes of long-lived classes must not "
+                   "grow in steady state without a maxlen/prune/rebind "
+                   "bound")
+
+    def run(self, project: Project) -> List[Finding]:
+        out: List[Finding] = []
+        for mod in project.modules.values():
+            for node in ast.walk(mod.tree):
+                if isinstance(node, ast.ClassDef) \
+                        and _LONGLIVED_RE.search(node.name):
+                    out.extend(self._check_class(mod, node))
+        return out
+
+    def _check_class(self, mod: ModuleInfo, cls: ast.ClassDef
+                     ) -> List[Finding]:
+        methods = [f for f in mod.functions if f.cls == cls.name]
+        init = next((f for f in methods
+                     if f.qualname == f"{cls.name}.__init__"), None)
+        if init is None:
+            return []
+        containers = self._containers(init)
+        if not containers:
+            return []
+        bound: Set[str] = {a for a, b in containers.items() if b}
+        growth: Dict[str, List[Tuple[str, int]]] = {}
+        for fi in methods:
+            name = fi.qualname.split(".")[-1]
+            if fi is init:
+                continue
+            taint = self._taint(fi, set(containers))
+            prune_named = _PRUNE_METHOD_RE.search(name) is not None
+            for node in body_nodes(fi.node):
+                if isinstance(node, ast.Call) \
+                        and isinstance(node.func, ast.Attribute):
+                    roots = self._attr_roots(node.func.value, taint)
+                    if node.func.attr in _SHRINK_OPS:
+                        bound |= roots
+                    elif node.func.attr in _GROWTH_OPS:
+                        for a in roots & set(containers):
+                            growth.setdefault(a, []).append(
+                                (fi.qualname, node.lineno))
+                elif isinstance(node, ast.Delete):
+                    for t in node.targets:
+                        bound |= self._attr_roots(t, taint)
+                elif isinstance(node, ast.Assign):
+                    for t in node.targets:
+                        if isinstance(t, ast.Attribute) \
+                                and isinstance(t.value, ast.Name) \
+                                and t.value.id == "self" \
+                                and t.attr in containers:
+                            bound.add(t.attr)  # steady-state rebind
+                if prune_named:
+                    bound |= {a for a in containers
+                              if self._references(fi, a)}
+        out: List[Finding] = []
+        for attr in sorted(set(growth) - bound):
+            sites = growth[attr]
+            wheres = sorted({q for q, _ in sites})
+            out.append(Finding(
+                rule=self.id, path=mod.path, line=sites[0][1],
+                message=(f"{cls.name}.{attr} grows in "
+                         f"{', '.join(wheres)} with no bound in evidence "
+                         f"(no deque(maxlen=), shrink op, rebind, or "
+                         f"prune path) — unbounded memory over a "
+                         f"long-lived instance"),
+                hint=("bound it (deque(maxlen=...), prune dead entries) "
+                      "or justify the cardinality inline"),
+                anchor=f"{cls.name}.{attr}"))
+        return out
+
+    @staticmethod
+    def _containers(init: FunctionInfo) -> Dict[str, bool]:
+        """self-attr name -> bounded?, for attrs initialized in __init__
+        to a container literal/ctor.  Attrs initialized from parameters
+        or arbitrary expressions are unknown and not tracked."""
+        out: Dict[str, bool] = {}
+        for node in body_nodes(init.node):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                tgt, val = node.targets[0], node.value
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                tgt, val = node.target, node.value
+            else:
+                continue
+            if not (isinstance(tgt, ast.Attribute)
+                    and isinstance(tgt.value, ast.Name)
+                    and tgt.value.id == "self"):
+                continue
+            if isinstance(val, (ast.List, ast.Dict, ast.Set)):
+                out[tgt.attr] = False
+            elif isinstance(val, ast.Call):
+                d = dotted(val.func)
+                leaf = d.rpartition(".")[2] if d else ""
+                if leaf == "deque":
+                    maxlen = next((kw.value for kw in val.keywords
+                                   if kw.arg == "maxlen"), None)
+                    out[tgt.attr] = not (
+                        maxlen is None
+                        or (isinstance(maxlen, ast.Constant)
+                            and maxlen.value is None))
+                elif leaf in _CONTAINER_CTORS:
+                    out[tgt.attr] = False
+        return out
+
+    @staticmethod
+    def _taint(fi: FunctionInfo, attrs: Set[str]
+               ) -> Dict[str, Set[str]]:
+        """local name -> tracked self-attrs it aliases (two passes, so
+        `for t in (self._a, self._b): d = t.get(k); del d[x]` bounds
+        both attrs — the MetricsRegistry retirement shape)."""
+        taint: Dict[str, Set[str]] = {}
+
+        def sources(node: ast.AST) -> Set[str]:
+            found: Set[str] = set()
+            for n in ast.walk(node):
+                if isinstance(n, ast.Attribute) \
+                        and isinstance(n.value, ast.Name) \
+                        and n.value.id == "self" and n.attr in attrs:
+                    found.add(n.attr)
+                elif isinstance(n, ast.Name) and n.id in taint:
+                    found |= taint[n.id]
+            return found
+
+        def targets(node: ast.AST) -> List[str]:
+            if isinstance(node, ast.Name):
+                return [node.id]
+            if isinstance(node, (ast.Tuple, ast.List)):
+                return [el.id for el in node.elts
+                        if isinstance(el, ast.Name)]
+            return []
+
+        for _ in range(2):
+            for node in body_nodes(fi.node):
+                if isinstance(node, ast.Assign):
+                    src = sources(node.value)
+                    if src:
+                        for t in node.targets:
+                            for name in targets(t):
+                                taint.setdefault(name, set()).update(src)
+                elif isinstance(node, ast.For):
+                    src = sources(node.iter)
+                    if src:
+                        for name in targets(node.target):
+                            taint.setdefault(name, set()).update(src)
+        return taint
+
+    @staticmethod
+    def _attr_roots(node: ast.AST, taint: Dict[str, Set[str]]
+                    ) -> Set[str]:
+        """Tracked attrs reachable at the root of an expression —
+        `self._x`, `self._x[k]`, or a tainted local alias."""
+        out: Set[str] = set()
+        for n in ast.walk(node):
+            if isinstance(n, ast.Attribute) \
+                    and isinstance(n.value, ast.Name) \
+                    and n.value.id == "self":
+                out.add(n.attr)
+            elif isinstance(n, ast.Name):
+                out |= taint.get(n.id, set())
+        return out
+
+    @staticmethod
+    def _references(fi: FunctionInfo, attr: str) -> bool:
+        return any(isinstance(n, ast.Attribute) and n.attr == attr
+                   and isinstance(n.value, ast.Name)
+                   and n.value.id == "self"
+                   for node in body_nodes(fi.node)
+                   for n in ast.walk(node))

@@ -101,7 +101,10 @@ class ArchiveSpool:
         must never take its producer down with it."""
         try:
             line = json.dumps(obj, separators=(",", ":")) + "\n"
-        except (TypeError, ValueError) as e:
+        except Exception as e:  # noqa: BLE001 — fail-open by contract:
+            # whatever the encoder throws (hostile __repr__, recursion,
+            # not just TypeError/ValueError) costs one counted record,
+            # never the producer thread
             self._drop("unserializable")
             self._log(f"archive: unserializable record dropped "
                       f"({type(e).__name__}: {e})")
@@ -111,7 +114,6 @@ class ArchiveSpool:
         fail_msg = None
         with self._lock:
             try:
-                # nerrflint: ok[blocking-under-lock] serializing segment IO is this lock's entire purpose: append/rotate/prune must never interleave on one directory; only the writer thread and maintenance calls ever contend here
                 self._rotate_if_due_locked()
                 fh = self._ensure_open_locked()
                 fh.write(data)
@@ -119,13 +121,17 @@ class ArchiveSpool:
                 self._active_bytes += len(data)
                 self.records += 1
                 self._broken = False
-            except OSError as e:
+            except Exception as e:  # noqa: BLE001 — fail-open: a
+                # non-OSError out of rotate/open/write is a spool bug,
+                # but it still must cost a counted drop, not the
+                # producer; the segment is closed and re-opened on the
+                # next append either way
                 self._close_locked()
                 ok = False
                 if not self._broken:
                     fail_msg = (f"archive: append failed "
                                 f"({type(e).__name__}: {e}); dropping "
-                                f"until the disk recovers")
+                                f"until the spool recovers")
                 self._broken = True
         if not ok:
             if fail_msg is not None:
@@ -146,7 +152,6 @@ class ArchiveSpool:
         fail_msg = None
         with self._lock:
             try:
-                # nerrflint: ok[blocking-under-lock] see append: the spool lock IS the segment-IO serializer
                 self._seal_locked()
                 self._prune_locked()
             except OSError as e:
@@ -219,7 +224,6 @@ class ArchiveSpool:
             os.fsync(self._fh.fileno())
         self._fh.close()
         final = self._active_path[:-len(OPEN_SUFFIX)]
-        # nerrflint: ok[blocking-under-lock] the rename that publishes a sealed segment must not race the next append's open
         os.replace(self._active_path, final)
         self._fh = None
         self._active_path = None

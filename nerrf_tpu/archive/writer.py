@@ -205,6 +205,7 @@ class ArchiveWriter:
 
     def _total(self, name: str, value: float) -> None:
         with self._sketch_lock:
+            # nerrflint: ok[bounded-growth] keyed by the fixed stage/sketch name set the observe calls hard-code — cardinality is code-constant, not traffic-driven
             t = self._totals.setdefault(name, [0, 0.0])
             t[0] += 1
             t[1] += value

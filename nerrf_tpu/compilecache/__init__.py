@@ -69,6 +69,7 @@ class StepCache:
                 self.jit_fn, args + self.tail, program=self.program,
                 extra=self.extra)
             self._fns[key] = hit
+            # nerrflint: ok[bounded-growth] one entry per distinct compiled signature — the zero-recompile contract pins that set (warmed ladder / flat train step), and a growing set here IS the recompile regression other rules catch
             self.infos.append(hit[1])
         return hit
 

@@ -122,7 +122,11 @@ def export_executables(out_dir, params, model, serve_cfg,
         # expected win), so a sidecar is attributable to its fit
         manifest["tuned"] = tuned_stamp
     out_dir.mkdir(parents=True, exist_ok=True)
-    (out_dir / MANIFEST).write_text(json.dumps(manifest, indent=2))
+    # the manifest commits the sidecar: serve boot reads it to decide the
+    # bundle is usable, so it must never be observable half-written
+    tmp = out_dir / (MANIFEST + ".tmp")
+    tmp.write_text(json.dumps(manifest, indent=2))
+    tmp.replace(out_dir / MANIFEST)
     return manifest
 
 

@@ -185,7 +185,11 @@ def export_corpus(traces: List[Trace], out_dir: str | Path,
             write_ground_truth_csv(t.ground_truth, out / gt_file)
             entry["ground_truth"] = gt_file
         manifest["traces"].append(entry)
-    (out / "manifest.json").write_text(json.dumps(manifest, indent=2) + "\n")
+    # manifest last and atomic: load_corpus keys off it, so a crash
+    # mid-export must leave "no corpus", never a torn manifest
+    tmp = out / "manifest.json.tmp"
+    tmp.write_text(json.dumps(manifest, indent=2) + "\n")
+    tmp.replace(out / "manifest.json")
     return out
 
 

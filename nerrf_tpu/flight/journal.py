@@ -110,6 +110,7 @@ KNOWN_KINDS = (
     "rollback_step_failed",
     "alert_disposition", "retrain_triggered", "retrain_done",
     "retrain_aborted",
+    "archive_meta", "metrics_snapshot", "workload_sketch", "replay_window",
     "exception", "bundle",
 )
 

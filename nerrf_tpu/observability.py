@@ -83,6 +83,7 @@ class MetricsRegistry:
             k = _labelkey(labels)
             d[k] = d.get(k, 0.0) + value
             if help:
+                # nerrflint: ok[bounded-growth] keyed by metric NAME — a code-constant set; remove_series retires label series, and one help line per name is not growth
                 self._help.setdefault(name, help)
 
     def gauge_set(self, name: str, value: float,

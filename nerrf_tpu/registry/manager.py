@@ -222,6 +222,12 @@ class ModelManager:
         # veto set is empty
         floor = max(target or 0,
                     int((live_rec or {}).get("previous") or 0))
+        # veto entries at/below the floor are dead — the staging filter
+        # below only ever considers v > floor — so drop them here, or a
+        # long-lived manager's veto set grows by one per rejected
+        # candidate for the lineage's lifetime
+        if any(v <= floor for v in self._vetoed):
+            self._vetoed = {v for v in self._vetoed if v > floor}
         newest = max(
             (v for v in self.store.versions(self.lineage)
              if v > floor and v not in self._vetoed),

@@ -235,6 +235,7 @@ class BatchedDeviceMCTS:
             ctx = _stack_ctx([dm._ctx] * B)
             # execute one 1-sim chunk: compile-AND-run proof, same gate as
             # DeviceMCTS.warmup
+            # nerrflint: ok[sync-in-hot-loop] deliberate warmup fence — each batch slot's compile must complete before serving, one sync per slot at startup only
             sync_result(search(tree, jnp.asarray(1, jnp.int32), ctx))
             self._warmed[(dims["F"], dims["P"],
                           float(dm.domain.max_steps), B)] = search

@@ -192,6 +192,7 @@ class MicroBatcher:
         """Register a bucket whose device program is compiled; scoring any
         other bucket after start counts into serve_recompiles_total."""
         with self._lock:
+            # nerrflint: ok[bounded-growth] one entry per bucket-ladder rung — warmup iterates the configured ladder and select_bucket cannot escape it, so the set is config-bounded
             self._warmed.add(tuple(bucket))
 
     def queue_depth(self, bucket: Bucket) -> int:

@@ -267,7 +267,11 @@ def generate_corpus(
         "auto_fit": fit_info,
         "dropped": drop_tally,
     }
-    man_path.write_text(json.dumps(man, indent=2) + "\n")
+    # the manifest commits the corpus (ShardedCorpus opens it first), so
+    # it lands atomically after every shard is on disk
+    tmp = man_path.with_name(man_path.name + ".tmp")
+    tmp.write_text(json.dumps(man, indent=2) + "\n")
+    tmp.replace(man_path)
     if log:
         log(f"corpus complete: {man['hours']:.1f}h, "
             f"{counts['train']} train / {counts['eval']} eval windows in "

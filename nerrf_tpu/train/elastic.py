@@ -92,7 +92,12 @@ def _save_full(ckpt_dir: Path, step: int, state) -> None:
                        jax.device_get({"params": state.params,
                                        "opt_state": state.opt_state}),
                        force=True)
-        (out / "meta.json").write_text(json.dumps({"step": step}) + "\n")
+        # meta.json IS the commit marker (latest_step treats its presence
+        # as "this checkpoint is complete"), so it must appear atomically:
+        # a torn marker would crash every future restore's json.loads
+        tmp = out / "meta.json.tmp"
+        tmp.write_text(json.dumps({"step": step}) + "\n")
+        tmp.replace(out / "meta.json")
     _heartbeat(ckpt_dir, step)
 
 

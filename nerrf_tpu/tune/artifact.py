@@ -103,8 +103,16 @@ def artifact_routing(art: dict) -> Tuple[Tuple[int, str], ...]:
 
 
 def save_artifact(path, art: dict) -> None:
-    Path(path).write_text(json.dumps(validate_artifact(art), indent=2)
-                          + "\n")
+    """Validate and atomically publish the tuned-ladder artifact.
+
+    Serve boots from this file (`--tuned`), so a crash mid-write must
+    never leave a torn JSON on the final name: stage to a tmp name in
+    the same directory and `os.replace` it into place, like every other
+    durable publish in the repo."""
+    p = Path(path)
+    tmp = p.with_name(p.name + ".tmp")
+    tmp.write_text(json.dumps(validate_artifact(art), indent=2) + "\n")
+    tmp.replace(p)
 
 
 def load_artifact(path) -> dict:

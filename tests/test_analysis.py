@@ -27,6 +27,12 @@ from nerrf_tpu.analysis.concurrency import (
     ThreadLifecycle,
 )
 from nerrf_tpu.analysis.locks import LockDiscipline
+from nerrf_tpu.analysis.operability import (
+    AtomicWrite,
+    BoundedGrowth,
+    FailurePolicy,
+    JournalContract,
+)
 from nerrf_tpu.analysis.purity import JaxPurity
 from nerrf_tpu.analysis.recompile import RecompileHazard
 from nerrf_tpu.analysis.syncs import SyncInHotLoop
@@ -34,7 +40,9 @@ from nerrf_tpu.analysis.syncs import SyncInHotLoop
 RULE_IDS = {"jax-purity", "recompile-hazard", "sync-in-hot-loop",
             "lock-discipline", "metrics-contract",
             "atomicity-violation", "callback-under-lock",
-            "blocking-under-lock", "thread-lifecycle"}
+            "blocking-under-lock", "thread-lifecycle",
+            "atomic-write", "journal-contract", "failure-policy",
+            "bounded-growth"}
 
 
 def _fixture(tmp_path: Path, files: dict) -> Path:
@@ -91,8 +99,13 @@ def test_json_schema_stable(repo_root):
     doc = json.loads(r.stdout)
     assert set(doc) == {"schema", "ok", "files", "elapsed_sec", "rules",
                         "findings", "suppressed", "stale_baseline", "errors"}
-    assert doc["schema"] == 1
+    # "1.1": rules entries gained per-rule wall time (elapsed_sec) so the
+    # queue pre-flights can log which rule eats the 10 s budget
+    assert doc["schema"] == "1.1"
     assert {ru["id"] for ru in doc["rules"]} == RULE_IDS
+    for ru in doc["rules"]:
+        assert set(ru) == {"id", "description", "elapsed_sec"}
+        assert isinstance(ru["elapsed_sec"], float) and ru["elapsed_sec"] >= 0
     assert doc["ok"] is True
     for f in doc["suppressed"]:
         assert set(f) == {"rule", "path", "line", "message", "hint",
@@ -836,3 +849,273 @@ def test_baseline_requires_justification(tmp_path):
                      baseline_path=bl)
     assert not report.ok
     assert any("no justification" in e for e in report.errors)
+
+
+# -- the operability tier -----------------------------------------------------
+
+
+def test_atomic_write_flags_in_place_durable_writes(tmp_path):
+    """A save-shaped function writing its durable artifact in place (no
+    tmp staging) fires; so does a direct open(.., "w") on a manifest."""
+    found = _run(tmp_path, {"pkg/artifact.py": """\
+        import json
+        from pathlib import Path
+
+        def save_artifact(path, art):
+            Path(path).write_text(json.dumps(art))
+
+        def export(out_dir, manifest):
+            with open(out_dir / "manifest.json", "w") as f:
+                json.dump(manifest, f)
+        """}, [AtomicWrite()])
+    assert {f.anchor for f in found} == {"save_artifact:path",
+                                         "export:manifest.json"}
+    assert all(f.rule == "atomic-write" for f in found)
+
+
+def test_atomic_write_quiet_on_staged_replace_and_unknown_paths(tmp_path):
+    """Staging to a tmp name (even through a local alias) is the legal
+    idiom; a write to a destination with no durable evidence is unknown,
+    not a finding; append mode is out of scope."""
+    found = _run(tmp_path, {"pkg/artifact.py": """\
+        import json
+        from pathlib import Path
+
+        def save_artifact(path, art):
+            p = Path(path)
+            staged = p.with_name(p.name + ".tmp")
+            staged.write_text(json.dumps(art))
+            staged.replace(p)
+
+        def scribble(path):
+            Path(path).write_text("x")
+
+        def tail(path):
+            with open(path, "a") as f:
+                f.write("line")
+        """}, [AtomicWrite()])
+    assert found == []
+
+
+_JOURNAL_FIXTURE = """\
+    KNOWN_KINDS = ("alpha", "beta", "gamma", "ghost")
+
+    class EventJournal:
+        def record(self, kind, **data):
+            pass
+"""
+
+
+def test_journal_contract_flags_unregistered_unreached_unresolved(tmp_path):
+    """An emitted-but-unregistered kind, a registered-but-unreached kind,
+    and a .record( site whose kind resolves to no literal all fire."""
+    found = _run(tmp_path, {
+        "pkg/journal.py": """\
+            KNOWN_KINDS = ("alpha", "ghost")
+
+            class EventJournal:
+                def record(self, kind, **data):
+                    pass
+            """,
+        "pkg/svc.py": """\
+            from pkg.journal import EventJournal
+
+            journal = EventJournal()
+
+            def emit():
+                journal.record("alpha")
+                journal.record("rogue")
+
+            def forward(kind):
+                journal.record(kind)  # no call sites: unresolvable
+            """,
+    }, [JournalContract(journal_module="pkg.journal")])
+    assert {f.anchor for f in found} == {"kind:rogue", "unreached:ghost",
+                                         "unresolved:forward"}
+
+
+def test_journal_contract_resolves_tuple_flow_consts_and_handlers(tmp_path):
+    """The greppable-literal escape hatches all resolve: tuple-literal →
+    unpack flow (the batcher watchdog shape), module constants, helper
+    params fed by call sites, hand-built {"v": .., "kind": ..} records,
+    and emitters that only live inside except handlers."""
+    found = _run(tmp_path, {
+        "pkg/journal.py": _JOURNAL_FIXTURE,
+        "pkg/svc.py": """\
+            from pkg.journal import EventJournal
+
+            journal = EventJournal()
+            DELTA_KIND = "gamma"
+
+            def watchdog(cond):
+                flipped = None
+                if cond:
+                    flipped = ("alpha", 1)
+                else:
+                    flipped = ("beta", 2)
+                kind, n = flipped
+                journal.record(kind, n=n)
+
+            def _emit(kind, data):
+                journal.record(kind, **data)
+
+            def guarded():
+                try:
+                    pass
+                except Exception:
+                    _emit("ghost", {"reason": "drop"})
+
+            def sketch():
+                return {"v": "1.0", "kind": DELTA_KIND, "data": {}}
+            """,
+    }, [JournalContract(journal_module="pkg.journal")])
+    assert found == []
+
+
+def test_failure_policy_flags_open_gaps_and_closed_swallows(tmp_path):
+    """Fail-open: no barrier / uncounted drop both fire.  Fail-closed: a
+    broad swallow fires.  A declared scope that no longer exists is a
+    stale declaration and fires too."""
+    found = _run(tmp_path, {"pkg/svc.py": """\
+        class EventSvc:
+            def on_event(self, x):
+                self.sink(x)
+
+            def on_tick(self, x):
+                try:
+                    self.sink(x)
+                except Exception:
+                    self.log("oops")
+
+        class StoreSvc:
+            def publish(self, p):
+                try:
+                    self.write(p)
+                except Exception:
+                    pass
+        """}, [FailurePolicy(
+            fail_open={"pkg.svc": ("EventSvc.on_event", "EventSvc.on_tick",
+                                   "EventSvc.gone")},
+            fail_closed={"pkg.svc": ("StoreSvc.publish",)})])
+    assert {f.anchor for f in found} == {
+        "EventSvc.on_event:no-barrier", "EventSvc.on_tick:uncounted",
+        "EventSvc.gone:missing", "StoreSvc.publish:swallow"}
+
+
+def test_failure_policy_quiet_on_disciplined_scopes(tmp_path):
+    """Counted drops pass fail-open; re-raise / failure-recording /
+    narrow enumerated catches all pass fail-closed."""
+    found = _run(tmp_path, {"pkg/svc.py": """\
+        class EventSvc:
+            def on_event(self, x):
+                try:
+                    self.sink(x)
+                except Exception:
+                    self._drop("emit_error")
+
+        class StoreSvc:
+            def publish(self, p):
+                try:
+                    self.write(p)
+                except OSError:
+                    self.cleanup()
+                    raise
+                except (ValueError, KeyError):
+                    return None
+
+            def execute(self, plan):
+                try:
+                    self.apply(plan)
+                except Exception as e:
+                    self.files_failed += 1
+        """}, [FailurePolicy(
+            fail_open={"pkg.svc": ("EventSvc.on_event",)},
+            fail_closed={"pkg.svc": ("StoreSvc.publish",
+                                     "StoreSvc.execute")})])
+    assert found == []
+
+
+def test_bounded_growth_flags_unbounded_longlived_state(tmp_path):
+    found = _run(tmp_path, {"pkg/svc.py": """\
+        class FooService:
+            def __init__(self):
+                self._seen = set()
+                self._log = []
+
+            def on_event(self, k):
+                self._seen.add(k)
+                self._log.append(k)
+        """}, [BoundedGrowth()])
+    assert {f.anchor for f in found} == {"FooService._seen",
+                                         "FooService._log"}
+
+
+def test_bounded_growth_quiet_on_bounded_pruned_and_shortlived(tmp_path):
+    """deque(maxlen=), shrink through a local alias (the MetricsRegistry
+    retirement shape), steady-state rebind, prune-named methods, and
+    classes that are not long-lived by name all stay quiet."""
+    found = _run(tmp_path, {"pkg/svc.py": """\
+        from collections import deque
+
+        class BarService:
+            def __init__(self):
+                self._recent = deque(maxlen=64)
+                self._pending = {}
+                self._tables = {}
+                self._idx = {}
+
+            def on_event(self, k):
+                self._recent.append(k)
+                self._pending.setdefault(k, 0)
+                self._tables.setdefault(k, 0)
+                self._idx.setdefault(k, 0)
+
+            def retire(self, k):
+                for table in (self._pending,):
+                    d = table
+                    d.pop(k, None)
+
+            def rotate(self):
+                self._tables = {}
+
+            def prune_idle(self):
+                if self._idx:
+                    pass
+
+        class Helper:
+            def __init__(self):
+                self._stuff = []
+
+            def push(self, x):
+                self._stuff.append(x)
+        """}, [BoundedGrowth()])
+    assert found == []
+
+
+def test_inline_markers_are_live(repo_root):
+    """The stale-suppression audit: every `# nerrflint: ok[rule]` marker
+    outside the analyzer's own sources (which quote the syntax in docs
+    and hints) must name a shipped shallow rule and suppress a finding
+    that actually fires — a marker that suppresses nothing is stale
+    documentation and must be deleted."""
+    from nerrf_tpu.analysis.engine import _SUPPRESS, default_rules
+
+    rep = analyze(repo_root)
+    shallow = {r.id for r in default_rules()}
+    live = {}
+    for f in rep.suppressed:
+        live.setdefault((f.path, f.rule), set()).add(f.line)
+    stale = []
+    for p in sorted((repo_root / "nerrf_tpu").rglob("*.py")):
+        rel = p.relative_to(repo_root).as_posix()
+        if rel.startswith("nerrf_tpu/analysis/"):
+            continue
+        for i, line in enumerate(p.read_text().splitlines(), 1):
+            m = _SUPPRESS.search(line)
+            if m is None:
+                continue
+            if m.group(1) not in shallow:
+                stale.append(f"{rel}:{i}: unknown rule {m.group(1)!r}")
+            elif not (live.get((rel, m.group(1)), set()) & {i, i + 1}):
+                stale.append(f"{rel}:{i}: suppresses nothing — delete it")
+    assert not stale, "stale inline markers:\n" + "\n".join(stale)

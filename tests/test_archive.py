@@ -194,6 +194,24 @@ class TestSpool:
                          labels={"reason": "unserializable"}) == 1
         assert spool.append({"fine": 1}) is True
 
+    def test_non_oserror_spool_bug_still_fails_open(self, tmp_path,
+                                                    monkeypatch):
+        """The fail-open barrier is `except Exception`, not an enumerated
+        list: even a spool BUG (a RuntimeError out of segment open, not
+        an OSError) costs one counted drop — never the producer thread."""
+        reg = MetricsRegistry(namespace="t")
+        spool = ArchiveSpool(SpoolConfig(out_dir=str(tmp_path)),
+                             registry=reg)
+        monkeypatch.setattr(
+            spool, "_ensure_open_locked",
+            lambda: (_ for _ in ()).throw(RuntimeError("spool bug")))
+        assert spool.append({"kind": "x"}) is False  # no raise
+        assert reg.value("archive_dropped_total",
+                         labels={"reason": "io_error"}) >= 1
+        monkeypatch.undo()
+        assert spool.append({"kind": "x"}) is True  # recovers
+        spool.close()
+
 
 # -- journal schema version ---------------------------------------------------
 
@@ -225,6 +243,20 @@ SAMPLE_DATA = {
     "fleet_shed": dict(victim="s1", reason="budget_burn",
                        burn_ratio=1.4,
                        ranking=[["s1", 1.4], ["s0", 0.2]]),
+    "archive_meta": dict(schema="1.0", hostname="pod-0", pid=42,
+                         snapshot_every_sec=30.0,
+                         segment_max_bytes=64 << 20,
+                         max_total_bytes=1 << 30),
+    "metrics_snapshot": dict(counters={"windows_total": 12.0},
+                             gauges={"queue_depth": 3.0}),
+    "workload_sketch": dict(cumulative=True,
+                            sketches={"e2e_sec": {"buckets": [1, 2]}},
+                            totals={"e2e_sec": {"count": 2, "sum": 0.4}}),
+    "replay_window": dict(session="sess-1", window_idx=7,
+                          lo_ns=0, hi_ns=10,
+                          bucket=[64, 128, 32], model_version=2,
+                          max_prob=0.93, nodes=10, edges=20, files=3,
+                          events=[]),
 }
 
 

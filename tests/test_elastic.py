@@ -72,3 +72,17 @@ def test_heartbeat_failure_detection(tmp_path):
     assert not stale_heartbeat(tmp_path, timeout_sec=60)
     hb.write_text(json.dumps({"step": 1, "ts": time.time() - 120}))
     assert stale_heartbeat(tmp_path, timeout_sec=60)
+
+
+def test_staged_meta_tmp_is_not_a_commit_marker(tmp_path):
+    """`_save_full` stages meta.json (the commit marker) to a .tmp name
+    and `os.replace`s it into place: a crash mid-stamp leaves only the
+    torn `meta.json.tmp`, which `latest_step` must not count as a
+    committed checkpoint."""
+    ok = tmp_path / "step_00000008"
+    ok.mkdir(parents=True)
+    (ok / "meta.json").write_text(json.dumps({"step": 8}) + "\n")
+    torn = tmp_path / "step_00000016"
+    torn.mkdir(parents=True)
+    (torn / "meta.json.tmp").write_text('{"step": 1')  # killed mid-write
+    assert latest_step(tmp_path) == 8

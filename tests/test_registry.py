@@ -616,3 +616,26 @@ def test_cli_models_lifecycle_roundtrip(tmp_path, ckpt_dir, capsys):
     assert cli.main(["models", "list", "--registry", regdir]) == 0
     out = json.loads(capsys.readouterr().out)
     assert "det" in out["lineages"]
+
+
+def test_manager_prunes_dead_veto_entries(tmp_path):
+    """Veto entries at/below the staging floor can never match again
+    (the filter only considers v > floor): poll() drops them so a
+    long-lived manager's veto set does not grow by one per rejected
+    candidate forever."""
+    from nerrf_tpu.train.checkpoint import save_checkpoint
+
+    store = ModelRegistry(tmp_path / "registry")
+    ck = tmp_path / "src"
+    save_checkpoint(ck, _leaf_params(0.25), JointConfig().small)
+    store.publish("det", ck)
+    store.promote("det", 1)
+    mgr = ModelManager(store, "det", cfg=RegistryConfig(poll_sec=60.0),
+                       registry=MetricsRegistry(namespace="test"))
+    try:
+        mgr._version = 1
+        mgr._vetoed.update({0, 1, 99})  # 0 and 1 are at/below the floor
+        mgr.poll()
+        assert mgr._vetoed == {99}
+    finally:
+        mgr.close()

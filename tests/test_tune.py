@@ -317,3 +317,33 @@ def test_aot_export_stamps_tuned_manifest(tmp_path):
         aot.export_executables).parameters
     assert "tuned" in inspect.signature(
         aot.export_for_checkpoint).parameters
+
+
+def test_save_artifact_atomic_under_crash(tmp_path, monkeypatch):
+    """Serve boots from this file (`--tuned`): a crash mid-write must
+    never leave a torn JSON on the final name — the stage-and-replace
+    publish keeps the previous artifact fully loadable."""
+    from nerrf_tpu.tune import artifact as am
+
+    path = tmp_path / "tuned.json"
+    art = tune(golden_corpus())
+    save_artifact(path, art)
+
+    real_write = am.Path.write_text
+
+    def crashing_write(self, text, *a, **kw):
+        if self.name.endswith(".tmp"):
+            real_write(self, text[: len(text) // 2], *a, **kw)
+            raise OSError("disk full mid-publish")
+        return real_write(self, text, *a, **kw)
+
+    monkeypatch.setattr(am.Path, "write_text", crashing_write)
+    newer = dict(art, corpus_fingerprint="f" * 16)
+    with pytest.raises(OSError):
+        save_artifact(path, newer)
+    monkeypatch.undo()
+    # the published artifact is the OLD one, intact and valid
+    assert load_artifact(path) == art
+    # and the survivor is still replaceable once the disk recovers
+    save_artifact(path, newer)
+    assert load_artifact(path) == newer
